@@ -1,0 +1,88 @@
+"""Headline benchmark: flagship 800×1200 fictitious-domain PCG solve.
+
+Prints ONE JSON line:
+    {"metric": "mlups", "value": N, "unit": "MLUPS", "vs_baseline": R}
+
+Baseline: the reference's stage4 MPI+CUDA single-GPU (Tesla P100) result on
+the same 800×1200 grid — 989 iterations in 0.83 s ⇒ ≈1141 MLUPS
+(BASELINE.md, Этап_4_1213.pdf Table 1). vs_baseline = ours / 1141.
+
+Runs on whatever accelerator JAX finds (TPU in the target environment; falls
+back to CPU so the harness never crashes). Uses all local devices: 1 device →
+single-device jit path; >1 → 2D-mesh shard_map path.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+STAGE4_1GPU_MLUPS = 1141.0  # 800×1200: (799·1199)·989 / 0.83 s / 1e6
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from poisson_tpu.analysis import l2_error_vs_analytic
+    from poisson_tpu.config import Problem
+    from poisson_tpu.parallel import make_solver_mesh, pcg_solve_sharded
+    from poisson_tpu.solvers.pcg import pcg_solve
+    from poisson_tpu.utils.timing import mlups
+
+    problem = Problem(M=800, N=1200)
+    dtype = jnp.float32
+    devices = jax.devices()
+
+    def run():
+        if len(devices) > 1:
+            mesh = make_solver_mesh(devices)
+            return pcg_solve_sharded(problem, mesh, dtype=dtype)
+        return pcg_solve(problem, dtype=dtype)
+
+    # Warm-up: trace + compile (cached for the timed runs).
+    t0 = time.perf_counter()
+    result = run()
+    result.w.block_until_ready()
+    compile_and_first = time.perf_counter() - t0
+
+    # Timed: best of 3 (the reference reports a single timed run on a quiet
+    # cluster; min-of-3 removes scheduler noise on shared hosts).
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        result = run()
+        result.w.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+
+    iters = int(result.iterations)
+    value = mlups(problem, iters, best)
+    err = float(l2_error_vs_analytic(problem, result.w))
+
+    print(
+        json.dumps(
+            {
+                "metric": "mlups",
+                "value": round(value, 1),
+                "unit": "MLUPS",
+                "vs_baseline": round(value / STAGE4_1GPU_MLUPS, 3),
+                "detail": {
+                    "grid": [problem.M, problem.N],
+                    "iterations": iters,
+                    "solve_seconds": round(best, 4),
+                    "first_run_seconds": round(compile_and_first, 2),
+                    "final_diff": float(result.diff),
+                    "l2_error_vs_analytic": err,
+                    "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+                    "devices": len(devices),
+                    "platform": devices[0].platform,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

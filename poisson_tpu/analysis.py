@@ -1,0 +1,28 @@
+"""Solution-quality analysis: the analytic accuracy control.
+
+The reference's final report controls accuracy against the exact solution
+u = (1 − x² − 4y²)/10 (``итоговый отчёт/Этап_4_1213.pdf`` p.1); no code for
+it survives in the reference repo (SURVEY §4.2), so this module recreates it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from poisson_tpu.config import Problem
+from poisson_tpu.models.fictitious_domain import analytic_solution, is_in_domain
+
+
+def l2_error_vs_analytic(problem: Problem, w) -> jnp.ndarray:
+    """Weighted L2 error over nodes strictly inside the ellipse.
+
+    Outside D the fictitious-domain solution is O(ε)-small but nonzero by
+    design, so the error is measured where the PDE actually holds."""
+    u = analytic_solution(problem, dtype=w.dtype)
+    i = jnp.arange(problem.M + 1)
+    j = jnp.arange(problem.N + 1)
+    x = (problem.x_min + i.astype(w.dtype) * problem.h1)[:, None]
+    y = (problem.y_min + j.astype(w.dtype) * problem.h2)[None, :]
+    mask = is_in_domain(x, y)
+    err2 = jnp.where(mask, (w - u) ** 2, 0.0)
+    return jnp.sqrt(jnp.sum(err2) * (problem.h1 * problem.h2))

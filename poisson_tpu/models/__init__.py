@@ -1,0 +1,17 @@
+from poisson_tpu.models.fictitious_domain import (
+    analytic_solution,
+    build_fields,
+    coefficient_fields,
+    is_in_domain,
+    rhs_field,
+    segment_length_in_domain,
+)
+
+__all__ = [
+    "analytic_solution",
+    "build_fields",
+    "coefficient_fields",
+    "is_in_domain",
+    "rhs_field",
+    "segment_length_in_domain",
+]

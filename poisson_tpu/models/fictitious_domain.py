@@ -1,0 +1,134 @@
+"""Problem setup: elliptic geometry and fictitious-domain coefficient fields.
+
+TPU-native re-design of the reference's scalar setup loops
+(``stage0/Withoutopenmp1.cpp:14-61`` ``if_is_in_D``/``cal_seg_len_in_D``/
+``fic_reg``; distributed variant ``stage2-mpi/poisson_mpi_decomp.cpp:124-170``
+``fic_reg_local``): everything here is closed-form and vectorised over index
+meshes, so a device shard can build exactly its own block (+halo ring) of the
+coefficient fields locally — the SPMD analog of ``fic_reg_local`` — with no
+scatter/gather and no host loop.
+
+Discretisation recap (matching the reference bit-for-bit in fp64):
+  - Grid nodes x_i = x_min + i·h1, y_j = y_min + j·h2, i=0..M, j=0..N.
+  - Edge coefficient a[i,j] sits on the *vertical* cell face at
+    x = x_i − h1/2, y ∈ [y_j − h2/2, y_j + h2/2]; b[i,j] on the *horizontal*
+    face at y = y_j − h2/2, x ∈ [x_i − h1/2, x_i + h1/2].
+  - With ℓ the face length inside D = {x²+4y² < 1} and h the face length:
+      coeff = 1               if |ℓ − h| < 1e-9   (face fully inside)
+            = 1/ε             if ℓ < 1e-9         (face fully outside)
+            = ℓ/h + (1−ℓ/h)/ε otherwise           (cut face)
+    with ε = max(h1,h2)²   (``stage0/Withoutopenmp1.cpp:53-54,108``).
+  - RHS B[i,j] = f_val · 1[(x_i,y_j) ∈ D]  (``stage0/Withoutopenmp1.cpp:57-60``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from poisson_tpu.config import Problem
+
+# The reference's exact-hit tolerances (``stage0/Withoutopenmp1.cpp:53-54``).
+_FACE_TOL = 1e-9
+
+
+def is_in_domain(x, y):
+    """Ellipse membership x² + 4y² < 1 (``stage0/Withoutopenmp1.cpp:14-16``)."""
+    return x * x + 4.0 * y * y < 1.0
+
+
+def segment_length_in_domain(const_coord, start_var, end_var, *, vertical: bool):
+    """Length of an axis-aligned segment's intersection with the ellipse.
+
+    Closed form via the ellipse half-width at the fixed coordinate
+    (``stage0/Withoutopenmp1.cpp:19-39``), vectorised: all arguments may be
+    arrays. The reference's |x0|≥1 / |2y0|≥1 early-outs coincide with the
+    clamped square root, so no branch is needed.
+    """
+    if vertical:
+        half = jnp.sqrt(jnp.maximum(0.0, (1.0 - const_coord * const_coord) / 4.0))
+    else:
+        half = jnp.sqrt(jnp.maximum(0.0, 1.0 - 4.0 * const_coord * const_coord))
+    return jnp.maximum(
+        0.0, jnp.minimum(end_var, half) - jnp.maximum(start_var, -half)
+    )
+
+
+def _blend(length, h, eps):
+    """ℓ → coefficient blend (full / empty / cut face), elementwise."""
+    frac = length / h
+    cut = frac + (1.0 - frac) / eps
+    return jnp.where(
+        jnp.abs(length - h) < _FACE_TOL,
+        1.0,
+        jnp.where(length < _FACE_TOL, 1.0 / eps, cut),
+    )
+
+
+def coefficient_fields(problem: Problem, i_idx, j_idx, dtype=jnp.float64):
+    """Edge coefficients a, b evaluated at the index mesh i_idx × j_idx.
+
+    ``i_idx``/``j_idx`` are 1-D integer arrays of *global* grid indices; the
+    result has shape (len(i_idx), len(j_idx)). Passing a sub-range builds a
+    shard's local block, the vectorised equivalent of
+    ``stage2-mpi/poisson_mpi_decomp.cpp:124-170``.
+    """
+    h1, h2, eps = problem.h1, problem.h2, problem.eps
+    x = (problem.x_min + i_idx.astype(dtype) * h1)[:, None]
+    y = (problem.y_min + j_idx.astype(dtype) * h2)[None, :]
+    la = segment_length_in_domain(
+        x - 0.5 * h1, y - 0.5 * h2, y + 0.5 * h2, vertical=True
+    )
+    lb = segment_length_in_domain(
+        y - 0.5 * h2, x - 0.5 * h1, x + 0.5 * h1, vertical=False
+    )
+    a = _blend(la, h2, eps).astype(dtype)
+    b = _blend(lb, h1, eps).astype(dtype)
+    return a, b
+
+
+def rhs_field(problem: Problem, i_idx, j_idx, dtype=jnp.float64):
+    """RHS B = f_val · 1[node ∈ D] at the index mesh, zero outside the
+    interior index range 1..M-1 × 1..N-1 (``stage0/Withoutopenmp1.cpp:57-60``).
+
+    Note: a sharded caller must additionally zero its local halo-ring
+    positions (whose *global* indices are interior but belong to a
+    neighbouring shard) — see ``parallel.pcg_sharded._local_fields``.
+    """
+    x = (problem.x_min + i_idx.astype(dtype) * problem.h1)[:, None]
+    y = (problem.y_min + j_idx.astype(dtype) * problem.h2)[None, :]
+    inside = is_in_domain(x, y)
+    interior_mask = (
+        (i_idx >= 1) & (i_idx <= problem.M - 1)
+    )[:, None] & ((j_idx >= 1) & (j_idx <= problem.N - 1))[None, :]
+    f = jnp.asarray(problem.f_val, dtype)
+    return jnp.where(inside & interior_mask, f, jnp.zeros((), dtype))
+
+
+def build_fields(problem: Problem, dtype=jnp.float64):
+    """Full-grid fields a, b, B of shape (M+1, N+1).
+
+    Row/column 0 of a and b are never read by the operators (the stencil only
+    touches indices ≥ 1) but are filled with the same closed form for shape
+    regularity.
+    """
+    i_idx = jnp.arange(problem.M + 1)
+    j_idx = jnp.arange(problem.N + 1)
+    a, b = coefficient_fields(problem, i_idx, j_idx, dtype)
+    rhs = rhs_field(problem, i_idx, j_idx, dtype)
+    return a, b, rhs
+
+
+def analytic_solution(problem: Problem, i_idx=None, j_idx=None, dtype=jnp.float64):
+    """Exact solution u = (1 − x² − 4y²)/10 inside D, 0 outside.
+
+    Satisfies −Δu = 1 in D, u = 0 on ∂D — the accuracy control used in the
+    reference's final report (``итоговый отчёт/Этап_4_1213.pdf`` p.1; no code
+    for it survives in the reference repo, SURVEY §4.2)."""
+    if i_idx is None:
+        i_idx = jnp.arange(problem.M + 1)
+    if j_idx is None:
+        j_idx = jnp.arange(problem.N + 1)
+    x = (problem.x_min + i_idx.astype(dtype) * problem.h1)[:, None]
+    y = (problem.y_min + j_idx.astype(dtype) * problem.h2)[None, :]
+    val = (1.0 - x * x - 4.0 * y * y) / 10.0
+    return jnp.where(is_in_domain(x, y), val, jnp.zeros((), dtype))

@@ -1,0 +1,17 @@
+from poisson_tpu.ops.stencil import (
+    apply_A,
+    apply_Dinv,
+    diag_D,
+    dot_weighted,
+    interior,
+    pad_interior,
+)
+
+__all__ = [
+    "apply_A",
+    "apply_Dinv",
+    "diag_D",
+    "dot_weighted",
+    "interior",
+    "pad_interior",
+]

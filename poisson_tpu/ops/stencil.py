@@ -1,0 +1,82 @@
+"""Operator library: variable-coefficient 5-point stencil, Jacobi
+preconditioner, weighted inner product.
+
+TPU-native re-design of the reference's per-point loops / CUDA kernels
+(``stage0/Withoutopenmp1.cpp:64-103`` ``dot``/``mat_A``/``mat_D``;
+``stage4-mpi+cuda/poisson_mpi_cuda_f.cu:507-598`` ``apply_A_kernel``/
+``apply_Dinv_kernel``/``dot_kernel``): each op is one fused array expression
+over static shapes, which XLA tiles onto the VPU and fuses with neighbouring
+elementwise work — there is no analog of stage4's kernel-launch +
+``cudaDeviceSynchronize`` per op (``…cu:860,886,913,940``).
+
+Array convention: full grids of shape (M+1, N+1); the Dirichlet ring
+(i ∈ {0, M} or j ∈ {0, N}) is identically zero for all solver state, matching
+the reference's halo-zero convention. Operators read the ring but only ever
+write the interior.
+
+These pure-JAX ops are the framework's *reference implementation* — the role
+stage4's retained CPU fallbacks played (SURVEY §7.5); fused Pallas TPU kernels
+for the hot per-iteration sweeps are A/B-tested against them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def interior(u):
+    """Interior view u[1:-1, 1:-1] (unknowns i=1..M-1, j=1..N-1)."""
+    return u[1:-1, 1:-1]
+
+
+def pad_interior(u_int):
+    """Embed an (M-1, N-1) interior block into the zero Dirichlet ring."""
+    return jnp.pad(u_int, 1)
+
+
+def apply_A(w, a, b, h1: float, h2: float):
+    """5-point variable-coefficient Laplacian, zero outside the interior.
+
+    (Aw)ij = −[a_{i+1,j}(w_{i+1,j}−w_ij) − a_ij(w_ij−w_{i−1,j})]/h1²
+             −[b_{i,j+1}(w_{i,j+1}−w_ij) − b_ij(w_ij−w_{i,j−1})]/h2²
+    (``stage0/Withoutopenmp1.cpp:75-88``).
+    """
+    wc = w[1:-1, 1:-1]
+    ax = (a[2:, 1:-1] * (w[2:, 1:-1] - wc) - a[1:-1, 1:-1] * (wc - w[:-2, 1:-1])) / (
+        h1 * h1
+    )
+    ay = (b[1:-1, 2:] * (w[1:-1, 2:] - wc) - b[1:-1, 1:-1] * (wc - w[1:-1, :-2])) / (
+        h2 * h2
+    )
+    return pad_interior(-(ax + ay))
+
+
+def diag_D(a, b, h1: float, h2: float):
+    """Jacobi diagonal D_ij = (a_{i+1,j}+a_ij)/h1² + (b_{i,j+1}+b_ij)/h2²
+    over the interior, shape (M-1, N-1) (``stage0/Withoutopenmp1.cpp:91-103``).
+    """
+    return (a[2:, 1:-1] + a[1:-1, 1:-1]) / (h1 * h1) + (
+        b[1:-1, 2:] + b[1:-1, 1:-1]
+    ) / (h2 * h2)
+
+
+def apply_Dinv(r, d):
+    """z = D⁻¹ r with a precomputed interior diagonal ``d`` (z=0 where D==0,
+    ``stage0/Withoutopenmp1.cpp:100``; D > 0 always holds here since a,b ≥ 1,
+    the guard is kept for parity).
+
+    The reference recomputes D from a, b on every call
+    (``stage0/Withoutopenmp1.cpp:91-103``, ``stage4:…cu:541-562`` — its
+    ``T_prec`` is 20% of stage4 runtime, BASELINE.md Table 2); a and b are
+    loop constants, so here D is hoisted out of the iteration. The division
+    (rather than a hoisted reciprocal multiply) is kept so fp64 results match
+    the reference bit-for-bit.
+    """
+    z = jnp.where(d != 0.0, r[1:-1, 1:-1] / jnp.where(d != 0.0, d, 1.0), 0.0)
+    return pad_interior(z)
+
+
+def dot_weighted(u, v, h1: float, h2: float):
+    """Weighted inner product h1·h2·Σ_interior u·v
+    (``stage0/Withoutopenmp1.cpp:64-72``)."""
+    return jnp.sum(u[1:-1, 1:-1] * v[1:-1, 1:-1]) * (h1 * h2)

@@ -1,0 +1,4 @@
+from poisson_tpu.parallel.mesh import choose_process_grid, make_solver_mesh
+from poisson_tpu.parallel.pcg_sharded import pcg_solve_sharded
+
+__all__ = ["choose_process_grid", "make_solver_mesh", "pcg_solve_sharded"]

@@ -1,0 +1,63 @@
+"""Device-mesh construction: the TPU-native process grid.
+
+The reference factorises the MPI world into a near-square Px×Py grid
+(``choose_process_grid``, ``stage2-mpi/poisson_mpi_decomp.cpp:60-64``) and
+assigns ranks row-major. Here the same factorisation chooses a 2D
+``jax.sharding.Mesh`` with axes ('x', 'y'); every per-rank concept of the
+reference (rank→(px,py), neighbour lookup, MPI_PROC_NULL edges) becomes a mesh
+coordinate / ``ppermute`` edge mask.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+X_AXIS = "x"
+Y_AXIS = "y"
+
+
+def choose_process_grid(size: int) -> tuple[int, int]:
+    """Near-square factorisation Px·Py = size, Px ≤ Py
+    (``stage2-mpi/poisson_mpi_decomp.cpp:60-64``)."""
+    px = int(math.isqrt(size))
+    while px > 1 and size % px != 0:
+        px -= 1
+    return px, size // px
+
+
+def make_solver_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    grid: Optional[tuple[int, int]] = None,
+) -> Mesh:
+    """2D mesh over ``devices`` (default: all) shaped by
+    :func:`choose_process_grid`.
+
+    On real TPU slices the device order from ``jax.devices()`` follows the
+    physical torus, so neighbouring mesh coordinates sit on neighbouring
+    chips and ``ppermute`` halo traffic rides single-hop ICI.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if grid is None:
+        grid = choose_process_grid(len(devices))
+    px, py = grid
+    if px * py != len(devices):
+        raise ValueError(f"grid {grid} != #devices {len(devices)}")
+    arr = np.asarray(devices).reshape(px, py)
+    return Mesh(arr, (X_AXIS, Y_AXIS))
+
+
+def block_size(total_interior: int, parts: int) -> int:
+    """Uniform per-shard block: ceil((M-1)/Px).
+
+    The reference balances blocks differing by ≤1
+    (``decompose_2d``, ``stage2:…cpp:75-111``); SPMD needs identical shapes
+    per shard, so we pad the interior to parts·block and mask the excess —
+    same arithmetic on the real unknowns, see ``parallel.pcg_sharded``.
+    """
+    return -(-total_interior // parts)

@@ -1,0 +1,3 @@
+from poisson_tpu.solvers.pcg import PCGResult, pcg_solve, pcg_step_fn
+
+__all__ = ["PCGResult", "pcg_solve", "pcg_step_fn"]

@@ -1,0 +1,225 @@
+"""Diagonally-preconditioned conjugate gradients as a ``lax.while_loop``.
+
+TPU-native re-design of the reference's host-driven iteration
+(``stage0/Withoutopenmp1.cpp:106-172`` ``solve``;
+``stage2-mpi/poisson_mpi_decomp.cpp:356-460`` ``solve_mpi``;
+``stage4-mpi+cuda/poisson_mpi_cuda_f.cu:688-983`` ``gradient_solver_mpi``):
+the whole solve — setup, iteration, convergence test — is one traced program.
+Unlike stage4, which synchronises the host after every kernel and round-trips
+partial sums over PCIe for each dot product (SURVEY §3.3), nothing here leaves
+the device until the loop exits.
+
+The reference implements this loop five separate times (serial, OpenMP, MPI,
+hybrid, CUDA). Here the loop skeleton exists once, parameterised by a
+:class:`PCGOps` bundle: the single-device bundle has a no-op halo exchange and
+plain sums; the sharded bundle (``parallel.pcg_sharded``) plugs in ``ppermute``
+halo exchange and ``psum`` reductions. Same controller, different backend —
+the factoring the reference never did.
+
+Iteration structure (exactly the reference's, ``stage2:…cpp:400-457``):
+    w0 = 0;  r0 = B;  z0 = D⁻¹r0;  p0 = z0;  ζ0 = (z0,r0)
+    repeat k = 1, 2, …:
+        Ap   = A p                      (halo exchange first, when sharded)
+        den  = (Ap, p);  stop if |den| < 1e-15 (degenerate, state kept)
+        α    = ζ/den
+        w   += αp;  r −= αAp;  diff = ‖αp‖  (weighted or not, Problem.weighted_norm)
+        z    = D⁻¹r;  ζ' = (z, r)
+        stop if diff < δ  (this iteration counts, updates kept)
+        β    = ζ'/ζ;  p = z + βp
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from poisson_tpu.config import Problem
+from poisson_tpu.models.fictitious_domain import build_fields
+from poisson_tpu.ops.stencil import (
+    apply_A,
+    apply_Dinv,
+    diag_D,
+    dot_weighted,
+)
+
+_DENOM_TOL = 1e-15  # degenerate-direction guard (stage2:…cpp:414)
+
+
+class PCGOps(NamedTuple):
+    """Backend bundle consumed by the shared PCG loop.
+
+    apply_A:   p (halo-fresh) → Ap, zero outside owned interior
+    apply_Dinv: r → D⁻¹r, zero outside owned interior
+    dot:       (u, v) → *global* weighted inner product h1·h2·Σ u·v
+    sqnorm:    u → *global* Σ_interior u², unweighted (the convergence sum;
+               weighting applied by the loop per Problem.weighted_norm)
+    exchange:  p → p with refreshed halos (identity on a single device)
+    """
+
+    apply_A: Callable
+    apply_Dinv: Callable
+    dot: Callable
+    sqnorm: Callable
+    exchange: Callable
+
+
+class PCGState(NamedTuple):
+    k: jnp.ndarray        # iterations completed (reference's `iter`)
+    done: jnp.ndarray     # converged or degenerate
+    w: jnp.ndarray
+    r: jnp.ndarray
+    z: jnp.ndarray
+    p: jnp.ndarray
+    zr: jnp.ndarray       # ζ = (z, r)
+    diff: jnp.ndarray     # last ‖w(k+1)−w(k)‖
+
+
+class PCGResult(NamedTuple):
+    w: jnp.ndarray           # full (M+1, N+1) solution grid
+    iterations: jnp.ndarray
+    diff: jnp.ndarray        # final update norm
+    residual_dot: jnp.ndarray  # final ζ = (D⁻¹r, r)
+
+
+def _select(pred, new, old):
+    return jax.tree_util.tree_map(
+        lambda n, o: lax.select(jnp.broadcast_to(pred, n.shape), n, o), new, old
+    )
+
+
+def init_state(ops: PCGOps, rhs) -> PCGState:
+    """w=0, r=B, z=D⁻¹r, p=z, ζ=(z,r)  (stage2:…cpp:384-396)."""
+    w = jnp.zeros_like(rhs)
+    r = rhs
+    z = ops.apply_Dinv(r)
+    p = z
+    zr = ops.dot(z, r)
+    return PCGState(
+        k=jnp.zeros((), jnp.int32),
+        done=jnp.asarray(False),
+        w=w, r=r, z=z, p=p, zr=zr,
+        diff=jnp.asarray(jnp.inf, rhs.dtype),
+    )
+
+
+def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
+             weighted_norm: bool, h1: float, h2: float) -> PCGState:
+    """Run the PCG while_loop to convergence; backend-agnostic."""
+
+    def body(s: PCGState) -> PCGState:
+        p = ops.exchange(s.p)
+        Ap = ops.apply_A(p)
+        denom = ops.dot(Ap, p)
+        degenerate = jnp.abs(denom) < _DENOM_TOL
+        alpha = s.zr / jnp.where(degenerate, 1.0, denom)
+
+        dw = alpha * p
+        w_new = s.w + dw
+        r_new = s.r - alpha * Ap
+        sq = ops.sqnorm(dw)
+        diff = jnp.sqrt(sq * (h1 * h2)) if weighted_norm else jnp.sqrt(sq)
+
+        z_new = ops.apply_Dinv(r_new)
+        zr_new = ops.dot(z_new, r_new)
+        converged = diff < delta
+
+        beta = zr_new / jnp.where(s.zr == 0.0, 1.0, s.zr)
+        p_new = z_new + beta * p
+
+        # Degenerate break happens before any update (stage2:…cpp:410-415):
+        # keep the old state entirely. Convergence break keeps this
+        # iteration's w/r/z updates (p is then irrelevant).
+        candidate = PCGState(
+            k=s.k + 1,
+            done=degenerate | converged,
+            w=w_new, r=r_new, z=z_new, p=p_new,
+            zr=zr_new, diff=diff,
+        )
+        kept = s._replace(k=s.k + 1, done=jnp.asarray(True))
+        return _select(degenerate, kept, candidate)
+
+    def cond(s: PCGState):
+        return (~s.done) & (s.k < max_iter)
+
+    return lax.while_loop(cond, body, init_state(ops, rhs))
+
+
+def single_device_ops(problem: Problem, a, b) -> PCGOps:
+    """Stage0/stage1-equivalent backend: whole grid on one device."""
+    h1, h2 = problem.h1, problem.h2
+    d = diag_D(a, b, h1, h2)
+    return PCGOps(
+        apply_A=lambda p: apply_A(p, a, b, h1, h2),
+        apply_Dinv=lambda r: apply_Dinv(r, d),
+        dot=lambda u, v: dot_weighted(u, v, h1, h2),
+        sqnorm=lambda u: jnp.sum(u[1:-1, 1:-1] * u[1:-1, 1:-1]),
+        exchange=lambda p: p,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _solve(problem: Problem, dtype_name: str) -> PCGResult:
+    dtype = jnp.dtype(dtype_name)
+    a, b, rhs = build_fields(problem, dtype=dtype)
+    ops = single_device_ops(problem, a, b)
+    s = pcg_loop(
+        ops, rhs,
+        delta=problem.delta, max_iter=problem.iteration_cap,
+        weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+    return PCGResult(w=s.w, iterations=s.k, diff=s.diff, residual_dot=s.zr)
+
+
+def resolve_dtype(dtype) -> str:
+    """Resolve the requested precision, refusing a silent fp64→fp32 downcast.
+
+    JAX downcasts float64 arrays to float32 unless ``jax_enable_x64`` is on;
+    an explicit fp64 request would then quietly run fp32 against δ=1e-6 and
+    miss the golden iteration counts. ``None`` picks the best available.
+    """
+    if dtype is None:
+        return "float64" if jax.config.jax_enable_x64 else "float32"
+    name = jnp.dtype(dtype).name
+    if name == "float64" and not jax.config.jax_enable_x64:
+        raise ValueError(
+            "float64 requested but jax_enable_x64 is off — the solve would "
+            "silently run in float32. Call "
+            "jax.config.update('jax_enable_x64', True) first, or pass an "
+            "explicit 32-bit dtype."
+        )
+    return name
+
+
+def pcg_solve(problem: Problem, dtype=None) -> PCGResult:
+    """Single-device solve (the stage0/stage1 workload, SURVEY §3.1).
+
+    jit-compiled end to end; ``dtype`` selects the precision policy
+    (fp64 for oracle parity on CPU, fp32/bf16 for TPU throughput;
+    default: fp64 when x64 is enabled, else fp32).
+    """
+    return _solve(problem, resolve_dtype(dtype))
+
+
+def pcg_step_fn(problem: Problem):
+    """One fused PCG iteration for the flagship single-device problem —
+    the jittable 'forward step' exposed to the harness (__graft_entry__)."""
+    h1, h2 = problem.h1, problem.h2
+
+    def step(w, r, z, p, zr, a, b, d):
+        Ap = apply_A(p, a, b, h1, h2)
+        denom = dot_weighted(Ap, p, h1, h2)
+        alpha = zr / denom
+        w = w + alpha * p
+        r = r - alpha * Ap
+        z = apply_Dinv(r, d)
+        zr_new = dot_weighted(z, r, h1, h2)
+        beta = zr_new / zr
+        p = z + beta * p
+        return w, r, z, p, zr_new
+
+    return step

@@ -1,0 +1,3 @@
+from poisson_tpu.utils.timing import PhaseTimer, SolveReport, mlups, solve_report
+
+__all__ = ["PhaseTimer", "SolveReport", "mlups", "solve_report"]

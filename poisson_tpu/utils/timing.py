@@ -1,0 +1,135 @@
+"""Instrumentation: phase timing and the solve report.
+
+TPU-native equivalent of stage4's manual ``MPI_Wtime`` bracketing
+(``stage4-mpi+cuda/poisson_mpi_cuda_f.cu:696-701,956-980``: five accumulators
+gpu/copy/comm/precond/dot, MPI_Reduce(MAX), rank-0 table; plus the
+init/solver/finalize phase split in ``main``, ``…cu:1010-1034``).
+
+Under XLA there is no per-op host bracketing — the whole solve is one fused
+device program, which is the point (stage4 lost 20%+ to per-op sync, BASELINE
+Table 2). What remains meaningful on the host side:
+
+- phase wall-clock (trace/compile vs execute, init vs solve), via
+  :class:`PhaseTimer` with explicit ``block_until_ready`` fencing — the
+  ``MPI_Barrier``+``MPI_Wtime`` pattern of ``stage2:…cpp:483-490``;
+- derived throughput (MLUPS = interior points × iterations / second — the
+  BASELINE.json metric);
+- for intra-program category breakdown, ``jax.profiler.trace`` captures a
+  device timeline (stage4's per-category table, done by the profiler instead
+  of hand-inserted timers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Optional
+
+import jax
+
+from poisson_tpu.config import Problem
+
+
+class PhaseTimer:
+    """Named wall-clock phases with device fencing.
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("solve"):
+    ...     result = pcg_solve(problem)   # doctest: +SKIP
+    >>> t.times["solve"]                  # doctest: +SKIP
+    """
+
+    def __init__(self) -> None:
+        self.times: dict[str, float] = {}
+
+    def phase(self, name: str):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                # Fence outstanding device work so the phase boundary is
+                # real (the MPI_Barrier+Wtime idiom, stage2:…cpp:483-490).
+                try:
+                    jax.effects_barrier()
+                except Exception:
+                    pass
+                timer.times[name] = timer.times.get(name, 0.0) + (
+                    time.perf_counter() - self._t0
+                )
+
+        return _Ctx()
+
+
+def mlups(problem: Problem, iterations: int, seconds: float) -> float:
+    """Million lattice-site updates per second: interior·iters/time/1e6 —
+    the BASELINE.json throughput metric."""
+    return problem.interior_points * iterations / seconds / 1e6
+
+
+@dataclasses.dataclass
+class SolveReport:
+    """Stage4-style result report (``…cu:969-980`` and the rank-0 result
+    line ``stage2:…cpp:493-498``), as structured data."""
+
+    M: int
+    N: int
+    iterations: int
+    solve_seconds: float
+    compile_seconds: float
+    mlups: float
+    final_diff: float
+    dtype: str
+    devices: int
+    mesh: Optional[tuple[int, int]] = None
+    l2_error: Optional[float] = None
+
+    def json_line(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    def table(self) -> str:
+        rows = [
+            f"M={self.M}, N={self.N} | Iter={self.iterations} "
+            f"| Time={self.solve_seconds:.4f} s",
+            f"  compile: {self.compile_seconds:.2f} s   dtype: {self.dtype}"
+            f"   devices: {self.devices}"
+            + (f"   mesh: {self.mesh[0]}x{self.mesh[1]}" if self.mesh else ""),
+            f"  throughput: {self.mlups:.0f} MLUPS   final ||dw||: "
+            f"{self.final_diff:.3e}"
+            + (
+                f"   L2 err vs analytic: {self.l2_error:.3e}"
+                if self.l2_error is not None
+                else ""
+            ),
+        ]
+        return "\n".join(rows)
+
+
+def solve_report(
+    problem: Problem,
+    result,
+    solve_seconds: float,
+    compile_seconds: float,
+    dtype: str,
+    devices: int = 1,
+    mesh: Optional[tuple[int, int]] = None,
+    l2_error: Optional[float] = None,
+) -> SolveReport:
+    iters = int(result.iterations)
+    return SolveReport(
+        M=problem.M,
+        N=problem.N,
+        iterations=iters,
+        solve_seconds=solve_seconds,
+        compile_seconds=compile_seconds,
+        mlups=mlups(problem, iters, solve_seconds),
+        final_diff=float(result.diff),
+        dtype=dtype,
+        devices=devices,
+        mesh=mesh,
+        l2_error=l2_error,
+    )

@@ -1,0 +1,116 @@
+"""Geometry & coefficient-field tests.
+
+Cross-checks the vectorised closed forms in models.fictitious_domain against
+an independent scalar re-derivation of the reference's setup
+(``stage0/Withoutopenmp1.cpp:19-61``).
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.models.fictitious_domain import (
+    analytic_solution,
+    build_fields,
+    is_in_domain,
+    segment_length_in_domain,
+)
+
+
+def _scalar_seg_len(const_coord, start_var, end_var, vertical):
+    """Scalar re-derivation of cal_seg_len_in_D (independent of the jnp path)."""
+    # Expression order mirrors the C++ exactly for bit-parity.
+    if vertical:
+        if abs(const_coord) >= 1.0:
+            return 0.0
+        half = math.sqrt(max(0.0, (1.0 - const_coord * const_coord) / 4.0))
+    else:
+        if abs(2.0 * const_coord) >= 1.0:
+            return 0.0
+        half = math.sqrt(max(0.0, 1.0 - 4.0 * const_coord * const_coord))
+    return max(0.0, min(end_var, half) - max(start_var, -half))
+
+
+def _scalar_coeff(length, h, eps):
+    if abs(length - h) < 1e-9:
+        return 1.0
+    if length < 1e-9:
+        return 1.0 / eps
+    return length / h + (1.0 - length / h) / eps
+
+
+@pytest.mark.parametrize("vertical", [True, False])
+def test_segment_length_matches_scalar(vertical):
+    rng = np.random.default_rng(0)
+    c = rng.uniform(-1.2, 1.2, size=200)
+    s = rng.uniform(-0.8, 0.8, size=200)
+    e = s + rng.uniform(0.0, 0.5, size=200)
+    got = np.asarray(
+        segment_length_in_domain(jnp.asarray(c), jnp.asarray(s), jnp.asarray(e),
+                                 vertical=vertical)
+    )
+    want = [_scalar_seg_len(ci, si, ei, vertical) for ci, si, ei in zip(c, s, e)]
+    # XLA contracts 1−c·c into an FMA on CPU; allow last-ulp drift vs libm.
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-13)
+
+
+def test_membership():
+    assert bool(is_in_domain(0.0, 0.0))
+    assert not bool(is_in_domain(1.0, 0.0))
+    assert not bool(is_in_domain(0.0, 0.5))
+    assert bool(is_in_domain(0.9, 0.1))
+
+
+@pytest.mark.parametrize("M,N", [(10, 10), (17, 23)])
+def test_coefficients_match_scalar_rederivation(M, N):
+    p = Problem(M=M, N=N)
+    a, b, rhs = build_fields(p)
+    a, b, rhs = np.asarray(a), np.asarray(b), np.asarray(rhs)
+    h1, h2, eps = p.h1, p.h2, p.eps
+    for i in range(1, M + 1):
+        for j in range(1, N + 1):
+            x, y = p.x_min + i * h1, p.y_min + j * h2
+            la = _scalar_seg_len(x - 0.5 * h1, y - 0.5 * h2, y + 0.5 * h2, True)
+            lb = _scalar_seg_len(y - 0.5 * h2, x - 0.5 * h1, x + 0.5 * h1, False)
+            # 1/eps amplifies the FMA-level drift in the face lengths; a
+            # misclassified face (full/cut/empty) would still fail at O(1/eps).
+            assert a[i, j] == pytest.approx(_scalar_coeff(la, h2, eps), abs=1e-9)
+            assert b[i, j] == pytest.approx(_scalar_coeff(lb, h1, eps), abs=1e-9)
+    # RHS: indicator of the ellipse at interior nodes only.
+    for i in range(0, M + 1):
+        for j in range(0, N + 1):
+            x, y = p.x_min + i * h1, p.y_min + j * h2
+            q = x * x + 4 * y * y
+            if abs(q - 1.0) < 1e-12:
+                # Node within an ulp of the ellipse boundary: membership is
+                # legitimately compiler-dependent (FMA contraction), skip.
+                continue
+            want = (
+                p.f_val
+                if (q < 1.0 and 1 <= i <= M - 1 and 1 <= j <= N - 1)
+                else 0.0
+            )
+            assert rhs[i, j] == want
+
+
+def test_coefficient_bounds():
+    p = Problem(M=40, N=40)
+    a, b, _ = build_fields(p)
+    # Coefficients lie in [1, 1/eps] by construction.
+    for arr in (a, b):
+        arr = np.asarray(arr)
+        assert arr.min() >= 1.0 - 1e-12
+        assert arr.max() <= 1.0 / p.eps + 1e-9
+
+
+def test_analytic_solution_boundary_conditions():
+    p = Problem(M=64, N=64)
+    u = np.asarray(analytic_solution(p))
+    assert u[0, :].max() == 0 and u[-1, :].max() == 0
+    assert u.max() <= 0.1 + 1e-15
+    # value at centre is 1/10
+    # centre node exists when M, N even
+    assert u[p.M // 2, p.N // 2] == pytest.approx(0.1, abs=1e-12)

@@ -1,0 +1,91 @@
+"""Golden-iteration-count and accuracy regressions for the PCG solver.
+
+The reference's de-facto regression oracle is the grid-determined PCG
+iteration count (SURVEY §4.1). Oracle values below were obtained by compiling
+and running the reference programs directly (stage0 as-is; stage2 at P=1 with
+a single-process MPI stub):
+
+    stage0 (unweighted norm): 10×10→17, 20×20→31, 40×40→61
+    stage2 (weighted norm):   40×40→50, 400×600→546, 800×1200→989
+
+546/989 match the published tables (BASELINE.md). The committed 40×40 weighted
+code gives 50, not the reports' 60 — the reports were generated from a variant
+not in the repo; we pin the as-committed behaviour.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.models.fictitious_domain import analytic_solution, is_in_domain
+from poisson_tpu.solvers.pcg import pcg_solve
+
+
+@pytest.mark.parametrize(
+    "M,N,weighted,expected",
+    [
+        (10, 10, False, {17}),
+        (20, 20, False, {31}),
+        # ±1: jnp.sum reduction order differs from the sequential C++ loop;
+        # at 40×40 the 61st unweighted diff sits within one ulp of δ.
+        (40, 40, False, {61, 62}),
+        (40, 40, True, {50}),
+    ],
+)
+def test_golden_iterations_small(M, N, weighted, expected):
+    r = pcg_solve(Problem(M=M, N=N, weighted_norm=weighted))
+    assert int(r.iterations) in expected
+    assert float(r.diff) < 1e-6
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("M,N,expected", [(400, 600, 546), (800, 1200, 989)])
+def test_golden_iterations_large(M, N, expected):
+    r = pcg_solve(Problem(M=M, N=N))
+    assert int(r.iterations) == expected
+
+
+def _l2_error_inside(p: Problem, w) -> float:
+    """L2(D) error vs u = (1−x²−4y²)/10, interior ellipse nodes only
+    (the reference's analytic accuracy control, SURVEY §4.2)."""
+    u = analytic_solution(p)
+    i = jnp.arange(p.M + 1)
+    j = jnp.arange(p.N + 1)
+    x = (p.x_min + i * p.h1)[:, None]
+    y = (p.y_min + j * p.h2)[None, :]
+    mask = is_in_domain(x, y)
+    err2 = jnp.where(mask, (w - u) ** 2, 0.0)
+    return float(jnp.sqrt(jnp.sum(err2) * p.h1 * p.h2))
+
+
+def test_analytic_accuracy_and_convergence_under_refinement():
+    errs = []
+    for M in (20, 40, 80):
+        p = Problem(M=M, N=M)
+        r = pcg_solve(p)
+        errs.append(_l2_error_inside(p, r.w))
+    # Fictitious-domain accuracy: error decreases under refinement.
+    assert errs[1] < errs[0]
+    assert errs[2] < errs[1]
+    assert errs[2] < 2e-3
+
+
+def test_solution_is_nonnegative_and_bounded():
+    p = Problem(M=40, N=40)
+    r = pcg_solve(p)
+    w = np.asarray(r.w)
+    assert w.min() > -1e-8
+    assert w.max() < 0.12  # max of exact solution is 0.1
+
+
+def test_float32_solves_same_problem():
+    """Precision policy (SURVEY §7.3): f32 must converge to the same solution
+    within f32-appropriate tolerance and a similar iteration count."""
+    p = Problem(M=40, N=40, delta=1e-4)
+    r64 = pcg_solve(p, dtype=jnp.float64)
+    r32 = pcg_solve(p, dtype=jnp.float32)
+    assert abs(int(r32.iterations) - int(r64.iterations)) <= 3
+    np.testing.assert_allclose(
+        np.asarray(r32.w), np.asarray(r64.w), atol=5e-4
+    )
