@@ -36,35 +36,48 @@ def is_in_domain(x, y):
     return x * x + 4.0 * y * y < 1.0
 
 
-def segment_length_in_domain(const_coord, start_var, end_var, *, vertical: bool):
+def segment_length_in_domain(const_coord, start_var, end_var, *,
+                             vertical: bool, xp=jnp):
     """Length of an axis-aligned segment's intersection with the ellipse.
 
     Closed form via the ellipse half-width at the fixed coordinate
     (``stage0/Withoutopenmp1.cpp:19-39``), vectorised: all arguments may be
     arrays. The reference's |x0|≥1 / |2y0|≥1 early-outs coincide with the
     clamped square root, so no branch is needed.
+
+    ``xp`` selects the array namespace (jnp on device; numpy for fp64 host
+    setup when x64 is unavailable, e.g. on TPU — the reference also does its
+    setup on the host, ``stage4:…cu:717``).
     """
     if vertical:
-        half = jnp.sqrt(jnp.maximum(0.0, (1.0 - const_coord * const_coord) / 4.0))
+        half = xp.sqrt(xp.maximum(0.0, (1.0 - const_coord * const_coord) / 4.0))
     else:
-        half = jnp.sqrt(jnp.maximum(0.0, 1.0 - 4.0 * const_coord * const_coord))
-    return jnp.maximum(
-        0.0, jnp.minimum(end_var, half) - jnp.maximum(start_var, -half)
+        half = xp.sqrt(xp.maximum(0.0, 1.0 - 4.0 * const_coord * const_coord))
+    return xp.maximum(
+        0.0, xp.minimum(end_var, half) - xp.maximum(start_var, -half)
     )
 
 
-def _blend(length, h, eps):
+def _blend(length, h, eps, xp=jnp):
     """ℓ → coefficient blend (full / empty / cut face), elementwise."""
     frac = length / h
     cut = frac + (1.0 - frac) / eps
-    return jnp.where(
-        jnp.abs(length - h) < _FACE_TOL,
+    return xp.where(
+        xp.abs(length - h) < _FACE_TOL,
         1.0,
-        jnp.where(length < _FACE_TOL, 1.0 / eps, cut),
+        xp.where(length < _FACE_TOL, 1.0 / eps, cut),
     )
 
 
-def coefficient_fields(problem: Problem, i_idx, j_idx, dtype=jnp.float64):
+def _node_coords(problem: Problem, i_idx, j_idx, dtype):
+    # Namespace-agnostic: inherits numpy/jnp from the index arrays.
+    x = (problem.x_min + i_idx.astype(dtype) * problem.h1)[:, None]
+    y = (problem.y_min + j_idx.astype(dtype) * problem.h2)[None, :]
+    return x, y
+
+
+def coefficient_fields(problem: Problem, i_idx, j_idx, dtype=jnp.float64,
+                       xp=jnp):
     """Edge coefficients a, b evaluated at the index mesh i_idx × j_idx.
 
     ``i_idx``/``j_idx`` are 1-D integer arrays of *global* grid indices; the
@@ -73,20 +86,19 @@ def coefficient_fields(problem: Problem, i_idx, j_idx, dtype=jnp.float64):
     ``stage2-mpi/poisson_mpi_decomp.cpp:124-170``.
     """
     h1, h2, eps = problem.h1, problem.h2, problem.eps
-    x = (problem.x_min + i_idx.astype(dtype) * h1)[:, None]
-    y = (problem.y_min + j_idx.astype(dtype) * h2)[None, :]
+    x, y = _node_coords(problem, i_idx, j_idx, dtype)
     la = segment_length_in_domain(
-        x - 0.5 * h1, y - 0.5 * h2, y + 0.5 * h2, vertical=True
+        x - 0.5 * h1, y - 0.5 * h2, y + 0.5 * h2, vertical=True, xp=xp
     )
     lb = segment_length_in_domain(
-        y - 0.5 * h2, x - 0.5 * h1, x + 0.5 * h1, vertical=False
+        y - 0.5 * h2, x - 0.5 * h1, x + 0.5 * h1, vertical=False, xp=xp
     )
-    a = _blend(la, h2, eps).astype(dtype)
-    b = _blend(lb, h1, eps).astype(dtype)
+    a = _blend(la, h2, eps, xp).astype(dtype)
+    b = _blend(lb, h1, eps, xp).astype(dtype)
     return a, b
 
 
-def rhs_field(problem: Problem, i_idx, j_idx, dtype=jnp.float64):
+def rhs_field(problem: Problem, i_idx, j_idx, dtype=jnp.float64, xp=jnp):
     """RHS B = f_val · 1[node ∈ D] at the index mesh, zero outside the
     interior index range 1..M-1 × 1..N-1 (``stage0/Withoutopenmp1.cpp:57-60``).
 
@@ -94,41 +106,40 @@ def rhs_field(problem: Problem, i_idx, j_idx, dtype=jnp.float64):
     positions (whose *global* indices are interior but belong to a
     neighbouring shard) — see ``parallel.pcg_sharded._local_fields``.
     """
-    x = (problem.x_min + i_idx.astype(dtype) * problem.h1)[:, None]
-    y = (problem.y_min + j_idx.astype(dtype) * problem.h2)[None, :]
+    x, y = _node_coords(problem, i_idx, j_idx, dtype)
     inside = is_in_domain(x, y)
     interior_mask = (
         (i_idx >= 1) & (i_idx <= problem.M - 1)
     )[:, None] & ((j_idx >= 1) & (j_idx <= problem.N - 1))[None, :]
-    f = jnp.asarray(problem.f_val, dtype)
-    return jnp.where(inside & interior_mask, f, jnp.zeros((), dtype))
+    f = xp.asarray(problem.f_val, dtype)
+    return xp.where(inside & interior_mask, f, xp.zeros((), dtype))
 
 
-def build_fields(problem: Problem, dtype=jnp.float64):
+def build_fields(problem: Problem, dtype=jnp.float64, xp=jnp):
     """Full-grid fields a, b, B of shape (M+1, N+1).
 
     Row/column 0 of a and b are never read by the operators (the stencil only
     touches indices ≥ 1) but are filled with the same closed form for shape
     regularity.
     """
-    i_idx = jnp.arange(problem.M + 1)
-    j_idx = jnp.arange(problem.N + 1)
-    a, b = coefficient_fields(problem, i_idx, j_idx, dtype)
-    rhs = rhs_field(problem, i_idx, j_idx, dtype)
+    i_idx = xp.arange(problem.M + 1)
+    j_idx = xp.arange(problem.N + 1)
+    a, b = coefficient_fields(problem, i_idx, j_idx, dtype, xp)
+    rhs = rhs_field(problem, i_idx, j_idx, dtype, xp)
     return a, b, rhs
 
 
-def analytic_solution(problem: Problem, i_idx=None, j_idx=None, dtype=jnp.float64):
+def analytic_solution(problem: Problem, i_idx=None, j_idx=None,
+                      dtype=jnp.float64, xp=jnp):
     """Exact solution u = (1 − x² − 4y²)/10 inside D, 0 outside.
 
     Satisfies −Δu = 1 in D, u = 0 on ∂D — the accuracy control used in the
     reference's final report (``итоговый отчёт/Этап_4_1213.pdf`` p.1; no code
     for it survives in the reference repo, SURVEY §4.2)."""
     if i_idx is None:
-        i_idx = jnp.arange(problem.M + 1)
+        i_idx = xp.arange(problem.M + 1)
     if j_idx is None:
-        j_idx = jnp.arange(problem.N + 1)
-    x = (problem.x_min + i_idx.astype(dtype) * problem.h1)[:, None]
-    y = (problem.y_min + j_idx.astype(dtype) * problem.h2)[None, :]
+        j_idx = xp.arange(problem.N + 1)
+    x, y = _node_coords(problem, i_idx, j_idx, dtype)
     val = (1.0 - x * x - 4.0 * y * y) / 10.0
-    return jnp.where(is_in_domain(x, y), val, jnp.zeros((), dtype))
+    return xp.where(is_in_domain(x, y), val, xp.zeros((), dtype))

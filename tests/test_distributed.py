@@ -32,18 +32,34 @@ def test_choose_process_grid_matches_reference():
 
 
 @pytest.mark.parametrize("ndev", [1, 2, 4, 8])
-def test_sharded_matches_single_device(ndev):
+@pytest.mark.parametrize("setup", ["host", "device"])
+def test_sharded_matches_single_device(ndev, setup):
     """Iteration-count and solution equality vs the single-device oracle —
     the reference's cross-implementation equivalence test (SURVEY §4.1),
     run on a virtual mesh instead of a cluster."""
     p = Problem(M=40, N=40)
     ref = pcg_solve(p)
     mesh = make_solver_mesh(jax.devices()[:ndev])
-    got = pcg_solve_sharded(p, mesh)
+    got = pcg_solve_sharded(p, mesh, setup=setup)
     # Reduction order differs between mesh shapes; counts may drift ±1.
     assert abs(int(got.iterations) - int(ref.iterations)) <= 1
     np.testing.assert_allclose(
         np.asarray(got.w), np.asarray(ref.w), atol=1e-10
+    )
+
+
+def test_sharded_f32_scaled_matches_goldens():
+    """The production TPU configuration: fp32 state, scaled system, host
+    fp64 setup, on a 2×4 mesh."""
+    import jax.numpy as jnp
+
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices())
+    got = pcg_solve_sharded(p, mesh, dtype=jnp.float32)
+    ref = pcg_solve(p)
+    assert int(got.iterations) == int(ref.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(got.w, np.float64), np.asarray(ref.w), atol=1e-5
     )
 
 

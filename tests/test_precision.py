@@ -1,0 +1,68 @@
+"""Precision-policy tests (SURVEY §7.3 — the hard correctness risk).
+
+The reference is fp64-only; TPUs want fp32. The fictitious-domain matrix has
+dynamic range ~1/ε·h⁻² (κ ~ 1e11 at 800×1200), so *unscaled* fp32 PCG
+diverges. The framework's answer is symmetric diagonal scaling: plain CG on
+Ã = D^{-1/2}AD^{-1/2} (unit diagonal, O(1) entries) is iterate-identical to
+Jacobi-PCG on A, and in fp32 it reproduces the fp64 golden iteration counts
+exactly. These tests pin that property.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_tpu.analysis import l2_error_vs_analytic
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers.pcg import pcg_solve
+
+
+def test_scaled_f64_is_iterate_identical_to_pcg():
+    p = Problem(M=40, N=40)
+    r_pcg = pcg_solve(p, dtype=jnp.float64, scaled=False)
+    r_scl = pcg_solve(p, dtype=jnp.float64, scaled=True)
+    assert int(r_pcg.iterations) == int(r_scl.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(r_scl.w), np.asarray(r_pcg.w), atol=1e-12
+    )
+
+
+def test_scaled_f32_matches_f64_golden_small():
+    p = Problem(M=40, N=40)
+    r64 = pcg_solve(p, dtype=jnp.float64)
+    r32 = pcg_solve(p, dtype=jnp.float32)  # scaled by default for f32
+    assert int(r32.iterations) == int(r64.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(r32.w, np.float64), np.asarray(r64.w), atol=1e-5
+    )
+
+
+@pytest.mark.slow
+def test_scaled_f32_matches_f64_golden_large():
+    p = Problem(M=400, N=600)
+    r32 = pcg_solve(p, dtype=jnp.float32)
+    assert int(r32.iterations) == 546
+    err = float(l2_error_vs_analytic(p, r32.w.astype(jnp.float64)))
+    # fp64 reference error is 3.06e-4; fp32-scaled must stay at that level.
+    assert err < 4e-4
+
+
+def test_f32_setup_precision_is_the_hazard():
+    """Canary documenting the precision policy: building the coefficient
+    fields (1/ε blends, D, scaling) in fp32 degrades the *problem itself* —
+    host fp64 setup is what keeps fp32 solves on the fp64 trajectory.
+    If device-f32 setup ever matches host setup here, the default could be
+    relaxed."""
+    import jax
+
+    from poisson_tpu.parallel import make_solver_mesh, pcg_solve_sharded
+
+    p = Problem(M=400, N=600)
+    mesh = make_solver_mesh(jax.devices()[:8])
+    host = pcg_solve_sharded(p, mesh, dtype=jnp.float32, setup="host")
+    dev = pcg_solve_sharded(p, mesh, dtype=jnp.float32, setup="device")
+    e_host = float(l2_error_vs_analytic(p, host.w.astype(jnp.float64)))
+    e_dev = float(l2_error_vs_analytic(p, dev.w.astype(jnp.float64)))
+    assert int(host.iterations) == 546
+    assert e_host < 4e-4  # fp64 reference level (3.1e-4)
+    assert e_dev > 5 * e_host
