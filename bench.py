@@ -29,7 +29,7 @@ def main() -> int:
     from poisson_tpu.config import Problem
     from poisson_tpu.parallel import make_solver_mesh, pcg_solve_sharded
     from poisson_tpu.solvers.pcg import pcg_solve
-    from poisson_tpu.utils.timing import mlups
+    from poisson_tpu.utils.timing import fence, mlups
 
     problem = Problem(M=800, N=1200)
     dtype = jnp.float32
@@ -44,17 +44,29 @@ def main() -> int:
     # Warm-up: trace + compile (cached for the timed runs).
     t0 = time.perf_counter()
     result = run()
-    result.w.block_until_ready()
+    fence(result)
     compile_and_first = time.perf_counter() - t0
 
-    # Timed: best of 3 (the reference reports a single timed run on a quiet
-    # cluster; min-of-3 removes scheduler noise on shared hosts).
-    best = float("inf")
-    for _ in range(3):
+    # Timing methodology. block_until_ready is not a real barrier on
+    # tunneled platforms (utils.timing.fence), and fetching any fresh output
+    # buffer costs a large constant latency (~65 ms measured over the axon
+    # tunnel) that would swamp the solve itself. So: time K_LO and K_HI
+    # chained solves, each closed by ONE scalar fetch, and difference them —
+    # the per-solve slope counts all real work (dispatch + full execution)
+    # while the constant fetch artifact cancels. Verified linear in K.
+    K_LO, K_HI = 1, 8
+
+    def timed_chain(k: int) -> float:
         t0 = time.perf_counter()
-        result = run()
-        result.w.block_until_ready()
-        best = min(best, time.perf_counter() - t0)
+        res = None
+        for _ in range(k):
+            res = run()
+        fence(res.iterations)
+        return time.perf_counter() - t0
+
+    t_lo = min(timed_chain(K_LO) for _ in range(3))
+    t_hi = min(timed_chain(K_HI) for _ in range(3))
+    best = (t_hi - t_lo) / (K_HI - K_LO)
 
     iters = int(result.iterations)
     value = mlups(problem, iters, best)

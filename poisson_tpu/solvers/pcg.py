@@ -301,7 +301,7 @@ def pcg_step_fn(problem: Problem, scaled: bool = True):
         ops = (
             scaled_single_device_ops(problem, a, b, aux)
             if scaled
-            else single_device_ops(problem, a, b, aux)
+            else single_device_ops(problem, a, b, aux[1:-1, 1:-1])
         )
         Ap = ops.apply_A(p)
         denom = ops.dot(Ap, p)

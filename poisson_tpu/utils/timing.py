@@ -31,6 +31,21 @@ import jax
 from poisson_tpu.config import Problem
 
 
+def fence(tree) -> None:
+    """Wait until every array in ``tree`` is actually computed.
+
+    ``block_until_ready`` alone is not trusted: on experimental/tunneled
+    platforms (e.g. the axon TPU transport) it can return while execution is
+    still in flight, which made 989-iteration solves appear to take 0 s.
+    Fetching a value to the host cannot lie, so after blocking this pulls
+    each array's first element (scalars whole) — a few bytes per leaf.
+    """
+    jax.block_until_ready(tree)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
+            jax.device_get(leaf.ravel()[0])
+
+
 class PhaseTimer:
     """Named wall-clock phases with device fencing.
 

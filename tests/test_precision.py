@@ -47,6 +47,7 @@ def test_scaled_f32_matches_f64_golden_large():
     assert err < 4e-4
 
 
+@pytest.mark.slow
 def test_f32_setup_precision_is_the_hazard():
     """Canary documenting the precision policy: building the coefficient
     fields (1/ε blends, D, scaling) in fp32 degrades the *problem itself* —
