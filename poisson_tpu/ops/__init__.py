@@ -7,6 +7,16 @@ from poisson_tpu.ops.stencil import (
     pad_interior,
 )
 
+def __getattr__(name):
+    # Lazy: pallas_cg imports solvers.pcg, which imports ops.stencil — an
+    # eager import here would close that cycle during package init.
+    if name == "pallas_cg_solve":
+        from poisson_tpu.ops.pallas_cg import pallas_cg_solve
+
+        return pallas_cg_solve
+    raise AttributeError(name)
+
+
 __all__ = [
     "apply_A",
     "apply_Dinv",
@@ -14,4 +24,5 @@ __all__ = [
     "dot_weighted",
     "interior",
     "pad_interior",
+    "pallas_cg_solve",
 ]

@@ -148,9 +148,14 @@ def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
     return lax.while_loop(cond, body, init_state(ops, rhs))
 
 
-def single_device_ops(problem: Problem, a, b, d) -> PCGOps:
-    """Stage0/stage1-equivalent backend: whole grid on one device."""
+def single_device_ops(problem: Problem, a, b, aux) -> PCGOps:
+    """Stage0/stage1-equivalent backend: whole grid on one device.
+
+    ``aux`` is the Jacobi diagonal embedded in the full grid's zero ring —
+    the same full-grid layout ``scaled_single_device_ops`` takes, so both
+    backends consume :func:`host_setup`'s aux unchanged."""
     h1, h2 = problem.h1, problem.h2
+    d = aux[1:-1, 1:-1]
     return PCGOps(
         apply_A=lambda p: apply_A(p, a, b, h1, h2),
         apply_Dinv=lambda r: apply_Dinv(r, d),
@@ -234,7 +239,7 @@ def _solve(problem: Problem, scaled: bool, a, b, rhs, aux) -> PCGResult:
     ops = (
         scaled_single_device_ops(problem, a, b, aux)
         if scaled
-        else single_device_ops(problem, a, b, aux[1:-1, 1:-1])
+        else single_device_ops(problem, a, b, aux)
     )
     s = pcg_loop(
         ops, rhs,
@@ -275,7 +280,8 @@ def resolve_scaled(scaled, dtype_name: str) -> bool:
     return bool(scaled)
 
 
-def pcg_solve(problem: Problem, dtype=None, scaled=None) -> PCGResult:
+def pcg_solve(problem: Problem, dtype=None, scaled=None,
+              rhs_gate=None) -> PCGResult:
     """Single-device solve (the stage0/stage1 workload, SURVEY §3.1).
 
     The iteration is jit-compiled end to end; setup runs on the host in fp64
@@ -283,10 +289,15 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None) -> PCGResult:
     oracle parity on CPU, fp32 for TPU throughput; default: fp64 when x64 is
     enabled, else fp32). ``scaled`` selects symmetric diagonal scaling
     (default: on for sub-64-bit dtypes — see :func:`scaled_single_device_ops`).
+    ``rhs_gate``, if given, is a traced scalar the RHS is multiplied by —
+    pass exactly 1.0 to chain benchmark solves with a data dependency
+    (serialized, bit-identical result).
     """
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
     a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+    if rhs_gate is not None:
+        rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     return _solve(problem, use_scaled, a, b, rhs, aux)
 
 
@@ -301,7 +312,7 @@ def pcg_step_fn(problem: Problem, scaled: bool = True):
         ops = (
             scaled_single_device_ops(problem, a, b, aux)
             if scaled
-            else single_device_ops(problem, a, b, aux[1:-1, 1:-1])
+            else single_device_ops(problem, a, b, aux)
         )
         Ap = ops.apply_A(p)
         denom = ops.dot(Ap, p)

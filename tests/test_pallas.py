@@ -1,0 +1,116 @@
+"""Fused Pallas kernel tests (SURVEY §7 step 5).
+
+The pure-JAX ops are the framework's reference implementation — the role
+stage4's retained CPU fallbacks played (``stage4:…cu:198-226``); these tests
+A/B the Pallas path against them, on CPU via interpret mode (the kernels
+themselves are what runs on TPU — same trace, different executor).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.ops import pallas_cg
+from poisson_tpu.ops.pallas_cg import HALO, build_canvases, pallas_cg_solve
+from poisson_tpu.ops.stencil import apply_A
+from poisson_tpu.solvers.pcg import host_fields64, pcg_solve
+
+
+@pytest.mark.parametrize(
+    "M,N,bm",
+    [
+        (40, 40, 16),     # square, interior 39 not divisible by bm
+        (80, 120, 16),    # rectangular
+        (40, 40, None),   # auto bm (larger than the grid)
+    ],
+)
+def test_full_solve_parity_vs_xla_f32(M, N, bm):
+    p = Problem(M=M, N=N)
+    r_ref = pcg_solve(p, dtype=jnp.float32)
+    r_pal = pallas_cg_solve(p, bm=bm)
+    assert int(r_pal.iterations) == int(r_ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(r_pal.w), np.asarray(r_ref.w), atol=1e-6
+    )
+
+
+def test_canvases_zero_outside_interior():
+    p = Problem(M=40, N=40)
+    cv, cs, cw, rhs, sc2, sc64 = build_canvases(p, 16)
+    band = slice(HALO, HALO + p.M - 1)
+    for name, arr, interior_cols in [
+        ("rhs", rhs, slice(1, p.N)),
+        ("sc2", sc2, slice(1, p.N)),
+    ]:
+        a = np.asarray(arr)
+        mask = np.zeros_like(a, bool)
+        mask[band, interior_cols] = True
+        assert (a[~mask] == 0).all(), name
+    # Coefficient canvases: every edge touching ring/guard/pad is zero, so
+    # the kernels need no interior masking (module docstring invariant).
+    for name, arr in [("cs", cs), ("cw", cw)]:
+        a = np.asarray(arr)
+        assert np.isfinite(a).all(), name
+        assert (a[:HALO] == 0).all(), name              # guard band
+        assert (a[HALO + p.M :] == 0).all(), name       # guard/pad rows
+        assert (a[HALO:, p.N + 1 :] == 0).all(), name   # pad columns
+        assert a[HALO:].any(), name                     # real coefficients exist
+    # Edges touching the Dirichlet ring vanish because sc is zero there:
+    # row HALO of cs is the i=1 south edge (neighbour is the ring), and
+    # column 1 of cw is the j=1 west edge.
+    assert (np.asarray(cs)[HALO] == 0).all()
+    assert (np.asarray(cw)[:, 1] == 0).all()
+    # …while the next edge inward is genuinely nonzero.
+    assert np.asarray(cs)[HALO + 1].any()
+    assert np.asarray(cw)[:, 2].any()
+
+
+def test_kernel_a_matches_scaled_operator():
+    """Kernel A's stencil (folded-coefficient form, 4 MACs/pt) against the
+    flux-form scaled operator sc·A(sc·y) built from ops.stencil."""
+    p = Problem(M=24, N=40)
+    cv, cs, cw, rhs, sc2, sc64 = build_canvases(p, 8)
+    rng = np.random.RandomState(0)
+
+    y_grid = np.zeros((p.M + 1, p.N + 1))
+    y_grid[1:-1, 1:-1] = rng.rand(p.M - 1, p.N - 1)
+
+    z = np.zeros((cv.rows, cv.cols), np.float32)
+    z[HALO : HALO + p.M - 1, : p.N + 1] = y_grid[1 : p.M, :]
+    z = jnp.asarray(z)
+    zero = jnp.zeros_like(z)
+    beta = jnp.zeros((1, 1), jnp.float32)
+
+    pn, ap, denom = pallas_cg.direction_and_stencil(
+        cv, beta, z, zero, cs, cw, interpret=True
+    )
+
+    a64, b64, _, sc = host_fields64(p, True)
+    want = sc * apply_A(sc * y_grid, a64, b64, p.h1, p.h2)
+    got = np.asarray(ap)[HALO : HALO + p.M - 1, : p.N + 1]
+    np.testing.assert_allclose(got, want[1:-1, :], atol=1e-5)
+    # and the fused dot partial is ⟨Ap, p⟩ (unweighted)
+    np.testing.assert_allclose(
+        float(denom[0, 0]), float((want[1:-1] * y_grid[1:-1]).sum()), rtol=1e-5
+    )
+
+
+def test_degenerate_direction_stops_cleanly():
+    """Zero RHS ⇒ zr=0, first denom=0 ⇒ degenerate guard: solver must stop
+    after one iteration with w=0, not NaN."""
+    p = Problem(M=16, N=16, max_iter=5)
+    cv, cs, cw, rhs, sc2, sc64 = build_canvases(p, 8)
+    s = pallas_cg._fused_solve(p, cv, True, cs, cw, jnp.zeros_like(rhs), sc2)
+    assert int(s.k) == 1
+    assert bool(s.done)
+    assert np.isfinite(np.asarray(s.w)).all()
+    assert (np.asarray(s.w) == 0).all()
+
+
+def test_gate_is_bit_exact():
+    p = Problem(M=40, N=40)
+    r1 = pallas_cg_solve(p)
+    r2 = pallas_cg_solve(p, rhs_gate=jnp.float32(1.0))
+    assert int(r1.iterations) == int(r2.iterations)
+    assert np.array_equal(np.asarray(r1.w), np.asarray(r2.w))
