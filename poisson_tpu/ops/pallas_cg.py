@@ -104,6 +104,10 @@ class Canvas(NamedTuple):
 
 def canvas_spec(problem: Problem, bm: int | None = None) -> Canvas:
     bm = bm if bm is not None else pick_bm(problem)
+    if bm <= 0 or bm % SUBLANE != 0:
+        # The strip/block index maps multiply in SUBLANE granules; any other
+        # bm would silently address the wrong rows.
+        raise ValueError(f"bm must be a positive multiple of {SUBLANE}, got {bm}")
     nb = -(-(problem.M - 1) // bm)
     return Canvas(bm=bm, nb=nb, rows=nb * bm + 2 * HALO,
                   cols=canvas_cols(problem))
@@ -124,8 +128,9 @@ def build_canvases(problem: Problem, bm: int | None = None,
     (or the guard/pad regions) gets coefficient 0 automatically, which is
     what lets the kernels run maskless.
 
-    Returns (cv, cS, cW, rhs, sc2, sc_grid): canvases as (R, C) device
-    arrays, plus the full-grid fp64 scaling for solution extraction.
+    Returns (cv, cS, cW, rhs, sc2, sc_int): canvases as (R, C) device
+    arrays, plus the interior scaling slice (device array) for solution
+    extraction.
     """
     cv = canvas_spec(problem, bm)
     dtype = jnp.dtype(dtype_name)
@@ -156,7 +161,7 @@ def build_canvases(problem: Problem, bm: int | None = None,
         as_dev(cw_canvas),
         as_dev(rhs_canvas),
         as_dev(sc2_canvas),
-        sc64,
+        as_dev(sc64[1:M, 1:N]),
     )
 
 
@@ -201,7 +206,7 @@ def _make_direction_stencil_kernel(cv: Canvas):
         c = pn[h:-h, :]                            # center rows
         cs_c = cs_ref[h:-h, :]                     # south-edge coeff at center
         cs_n = cs_ref[h + 1 : -h + 1, :]           # north edge = cS shifted down
-        cw_c = cw_ref[h:-h, :]
+        cw_c = cw_ref[:]                           # block-spec'd: center rows only
         ap = c - (
             cs_n * pn[h + 1 : -h + 1, :]
             + cs_c * pn[h - 1 : -h - 1, :]
@@ -281,10 +286,10 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, *, interpret: bool):
         grid=(cv.nb,),
         in_specs=[
             _scalar_spec(),
-            _strip_in_spec(cv),
-            _strip_in_spec(cv),
-            _strip_in_spec(cv),
-            _strip_in_spec(cv),
+            _strip_in_spec(cv),   # z: halo rows feed the stencil
+            _strip_in_spec(cv),   # p: ditto
+            _strip_in_spec(cv),   # cs: needs rows up to center+1
+            _block_spec(cv),      # cw: only center rows are read
         ],
         out_specs=[_block_spec(cv), _block_spec(cv), _scalar_spec()],
         out_shape=[
@@ -400,13 +405,12 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    cv, cs, cw, rhs, sc2, sc64 = build_canvases(problem, bm, dtype_name)
+    cv, cs, cw, rhs, sc2, sc_int = build_canvases(problem, bm, dtype_name)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     s = _fused_solve(problem, cv, interpret, cs, cw, rhs, sc2)
     # Canvas → full-grid solution, unscaled: w = sc · y.
     M, N = problem.M, problem.N
     y = s.w[HALO : HALO + M - 1, 1:N]
-    sc_int = jnp.asarray(sc64[1:M, 1:N], y.dtype)
     w = jnp.pad(y * sc_int, 1)
     return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr)

@@ -1,0 +1,67 @@
+"""Native C++ oracle: golden counts, OpenMP behaviour, parity with JAX.
+
+The reference's serial/OpenMP stages are native C++ compared empirically
+across implementations (SURVEY §4.1); here the native backend and the
+JAX/XLA backend are compared *in-process* — same golden iteration counts,
+same solution to fp64 round-off.
+"""
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.native import build, has_openmp, native_solve
+from poisson_tpu.solvers.pcg import pcg_solve
+
+
+def test_build_produces_library():
+    path = build()
+    assert path.endswith(".so")
+
+
+@pytest.mark.parametrize(
+    "M,N,weighted,expected",
+    [
+        (10, 10, False, 17),
+        (20, 20, False, 31),
+        (40, 40, False, 61),
+        (40, 40, True, 50),
+    ],
+)
+def test_native_golden_iterations(M, N, weighted, expected):
+    # num_threads=1: exact counts need a fixed reduction order (the default
+    # team is machine- and test-order-dependent).
+    r = native_solve(Problem(M=M, N=N, weighted_norm=weighted), num_threads=1)
+    assert r.iterations == expected
+    assert r.diff < 1e-6
+
+
+def test_native_matches_jax_fp64():
+    """Cross-backend equivalence: the reference's only correctness method
+    (SURVEY §4.1), automated. Summation order differs (sequential vs XLA
+    tree reduction), so parity is to round-off, not bitwise."""
+    p = Problem(M=40, N=40)
+    rn = native_solve(p, num_threads=1)
+    rj = pcg_solve(p)
+    assert rn.iterations == int(rj.iterations)
+    np.testing.assert_allclose(rn.w, np.asarray(rj.w), rtol=0, atol=1e-10)
+
+
+def test_native_openmp_thread_counts_agree():
+    """The stage1 experiment (thread sweep, same answer): iteration count
+    is reduction-order sensitive only within one ulp of delta, so allow ±1;
+    solutions must agree to round-off."""
+    if not has_openmp():
+        pytest.skip("library built without OpenMP")
+    p = Problem(M=40, N=40)
+    base = native_solve(p, num_threads=1)
+    for t in (2, 4):
+        r = native_solve(p, num_threads=t)
+        assert abs(r.iterations - base.iterations) <= 1
+        np.testing.assert_allclose(r.w, base.w, rtol=0, atol=1e-10)
+
+
+@pytest.mark.slow
+def test_native_golden_400x600():
+    r = native_solve(Problem(M=400, N=600), num_threads=4)
+    assert r.iterations == 546
