@@ -1,0 +1,3 @@
+from poisson_tpu.cli import main
+
+raise SystemExit(main())

@@ -13,16 +13,17 @@ from poisson_tpu.config import Problem
 from poisson_tpu.models.fictitious_domain import analytic_solution, is_in_domain
 
 
-def l2_error_vs_analytic(problem: Problem, w) -> jnp.ndarray:
+def l2_error_vs_analytic(problem: Problem, w, xp=jnp):
     """Weighted L2 error over nodes strictly inside the ellipse.
 
     Outside D the fictitious-domain solution is O(ε)-small but nonzero by
-    design, so the error is measured where the PDE actually holds."""
-    u = analytic_solution(problem, dtype=w.dtype)
-    i = jnp.arange(problem.M + 1)
-    j = jnp.arange(problem.N + 1)
+    design, so the error is measured where the PDE actually holds.
+    ``xp=numpy`` serves jax-free callers (the native CLI backend)."""
+    u = analytic_solution(problem, dtype=w.dtype, xp=xp)
+    i = xp.arange(problem.M + 1)
+    j = xp.arange(problem.N + 1)
     x = (problem.x_min + i.astype(w.dtype) * problem.h1)[:, None]
     y = (problem.y_min + j.astype(w.dtype) * problem.h2)[None, :]
     mask = is_in_domain(x, y)
-    err2 = jnp.where(mask, (w - u) ** 2, 0.0)
-    return jnp.sqrt(jnp.sum(err2) * (problem.h1 * problem.h2))
+    err2 = xp.where(mask, (w - u) ** 2, 0.0)
+    return xp.sqrt(xp.sum(err2) * (problem.h1 * problem.h2))

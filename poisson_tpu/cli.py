@@ -1,0 +1,273 @@
+"""Command-line driver: the framework's equivalent of the reference `main()`s.
+
+The reference drives each stage with positional ``M N`` argv, compile-time
+constants for everything else, and rank-0 stdout reporting
+(``stage2-mpi/poisson_mpi_decomp.cpp:463-502``,
+``stage4-mpi+cuda/poisson_mpi_cuda_f.cu:986-1039``). This driver exposes the
+same workloads over one interface with every constant promoted to a flag:
+
+    python -m poisson_tpu M N [--backend auto|xla|pallas|sharded|native]
+                              [--mesh PxxPy] [--dtype ...] [--delta ...]
+                              [--threads T] [--repeat K] [--json]
+                              [--categories] [--profile DIR]
+
+Instrumentation (stage4's ``MPI_Wtime`` bracketing + timer table, SURVEY §5):
+- phase wall-clock: setup / compile+first-solve / solve (best of --repeat);
+- ``--categories``: reconstructed per-op decomposition of one iteration
+  (stencil / preconditioner / dots / axpy), the analog of stage4's
+  gpu/precond/dot table — *reconstructed* because the real solve is one
+  fused device program, which is the point;
+- ``--profile DIR``: a real device timeline via ``jax.profiler.trace``
+  (what stage4's hand-inserted timers approximated).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+from poisson_tpu.config import Problem
+from poisson_tpu.utils.timing import PhaseTimer, fence, mlups, solve_report
+
+
+def _parse_mesh(spec: str) -> tuple[int, int]:
+    try:
+        px, py = spec.lower().split("x")
+        return int(px), int(py)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"mesh must look like '2x4', got {spec!r}"
+        )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m poisson_tpu",
+        description="Fictitious-domain Poisson PCG solve (TPU-native framework).",
+    )
+    p.add_argument("M", type=int, help="grid cells in x (nodes: M+1)")
+    p.add_argument("N", type=int, help="grid cells in y (nodes: N+1)")
+    p.add_argument("--delta", type=float, default=1e-6,
+                   help="convergence threshold on ||w(k+1)-w(k)|| (default 1e-6)")
+    p.add_argument("--max-iter", type=int, default=None,
+                   help="iteration cap (default (M-1)(N-1))")
+    p.add_argument("--backend",
+                   choices=("auto", "xla", "pallas", "sharded", "native"),
+                   default="auto",
+                   help="auto: sharded if >1 device, pallas on 1 TPU, else xla")
+    p.add_argument("--mesh", type=_parse_mesh, default=None, metavar="PXxPY",
+                   help="device mesh shape for --backend sharded (default: "
+                        "near-square over all devices)")
+    p.add_argument("--setup", choices=("host", "device"), default="host",
+                   help="sharded field setup: host fp64 or per-shard on-device")
+    p.add_argument("--dtype", choices=("float32", "float64"), default=None,
+                   help="state precision (default: float64 if x64 on, else float32)")
+    p.add_argument("--threads", type=int, default=0,
+                   help="OpenMP threads for --backend native (0 = runtime default)")
+    p.add_argument("--unweighted-norm", action="store_true",
+                   help="stage0's unweighted convergence norm")
+    p.add_argument("--repeat", type=int, default=1,
+                   help="timed solve repetitions; report the best")
+    p.add_argument("--json", action="store_true", help="one JSON line instead of a table")
+    p.add_argument("--categories", action="store_true",
+                   help="reconstructed per-op timing decomposition (stage4's table)")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="capture a jax.profiler trace of one solve into DIR")
+    return p
+
+
+def _problem(args) -> Problem:
+    return Problem(
+        M=args.M, N=args.N, delta=args.delta, max_iter=args.max_iter,
+        weighted_norm=not args.unweighted_norm,
+    )
+
+
+def _l2_error_np(problem: Problem, w: np.ndarray) -> float:
+    """Host-side (numpy) L2(D) error — no device round-trip, and serves the
+    jax-free native backend."""
+    from poisson_tpu.analysis import l2_error_vs_analytic
+
+    return float(
+        l2_error_vs_analytic(problem, np.asarray(w, np.float64), xp=np)
+    )
+
+
+def _run_native(args, problem: Problem):
+    from poisson_tpu.native import build, native_solve
+
+    build()  # one-time g++ compile stays out of the timed phases
+    timer = PhaseTimer()
+    with timer.phase("first_solve"):
+        result = native_solve(problem, num_threads=args.threads)
+    best = timer.times["first_solve"]
+    for _ in range(max(0, args.repeat - 1)):
+        t0 = time.perf_counter()
+        result = native_solve(problem, num_threads=args.threads)
+        best = min(best, time.perf_counter() - t0)
+    report = solve_report(
+        problem, result, best, compile_seconds=0.0, dtype="float64",
+        devices=0, l2_error=_l2_error_np(problem, result.w),
+    )
+    return report, timer
+
+
+def _pick_backend(args) -> str:
+    import jax
+
+    if args.backend != "auto":
+        return args.backend
+    devices = jax.devices()
+    if len(devices) > 1 or args.mesh is not None:
+        return "sharded"
+    if devices[0].platform == "tpu":
+        return "pallas"
+    return "xla"
+
+
+def _run_jax(args, problem: Problem, backend: str):
+    import jax
+
+    timer = PhaseTimer()
+    mesh_shape: Optional[tuple[int, int]] = None
+    devices = jax.devices()
+
+    if backend == "sharded":
+        from poisson_tpu.parallel import make_solver_mesh, pcg_solve_sharded
+
+        mesh = make_solver_mesh(grid=args.mesh)
+        mesh_shape = (mesh.shape["x"], mesh.shape["y"])
+        run = lambda: pcg_solve_sharded(
+            problem, mesh, dtype=args.dtype, setup=args.setup
+        )
+        n_dev = mesh_shape[0] * mesh_shape[1]
+    elif backend == "pallas":
+        if args.dtype == "float64":
+            raise SystemExit(
+                "--backend pallas is the fp32 fused path; use --backend xla "
+                "for float64"
+            )
+        from poisson_tpu.ops.pallas_cg import pallas_cg_solve
+
+        run = lambda: pallas_cg_solve(problem)
+        n_dev = 1
+    else:
+        from poisson_tpu.solvers.pcg import pcg_solve
+
+        run = lambda: pcg_solve(problem, dtype=args.dtype)
+        n_dev = 1
+
+    with timer.phase("compile_and_first_solve"):
+        result = run()
+        fence(result)
+    best = None
+    for _ in range(max(1, args.repeat)):
+        t0 = time.perf_counter()
+        result = run()
+        fence(result.iterations)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+
+    if args.profile:
+        with jax.profiler.trace(args.profile):
+            fence(run().iterations)
+
+    from poisson_tpu.solvers.pcg import resolve_dtype
+
+    dtype_name = "float32" if backend == "pallas" else resolve_dtype(args.dtype)
+    report = solve_report(
+        problem, result, best,
+        compile_seconds=timer.times["compile_and_first_solve"] - best,
+        dtype=dtype_name, devices=n_dev, mesh=mesh_shape,
+        l2_error=_l2_error_np(problem, np.asarray(result.w)),
+    )
+    return report, timer
+
+
+def _categories_table(problem: Problem, dtype, iters: int) -> list[str]:
+    """Reconstructed per-iteration op decomposition — the stage4 timer table
+    (``…cu:969-980``) rebuilt by timing each op in isolation. The production
+    solve fuses these; the table shows where the per-iteration work would go
+    if it were staged like the reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from poisson_tpu.ops.stencil import apply_A, apply_Dinv, diag_D, dot_weighted
+    from poisson_tpu.solvers.pcg import host_setup
+
+    a, b, rhs, aux = host_setup(problem, jnp.dtype(dtype).name, False)
+    d = aux[1:-1, 1:-1]
+    h1, h2 = problem.h1, problem.h2
+    p = rhs
+
+    ops = {
+        "stencil (mat_A)": jax.jit(lambda u: apply_A(u, a, b, h1, h2)),
+        "preconditioner (mat_D)": jax.jit(lambda u: apply_Dinv(u, d)),
+        "dot products x3": jax.jit(
+            lambda u: (dot_weighted(u, u, h1, h2),
+                       dot_weighted(u, rhs, h1, h2),
+                       dot_weighted(rhs, rhs, h1, h2))
+        ),
+        "axpy sweeps (w,r,p)": jax.jit(
+            lambda u: (u + 0.5 * rhs, u - 0.5 * rhs, rhs + 0.5 * u)
+        ),
+    }
+    reps = 20
+    rows, total = [], 0.0
+    for name, fn in ops.items():
+        fence(fn(p))  # compile
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(reps):
+            out = fn(p)
+        fence(out)
+        per_iter = (time.perf_counter() - t0) / reps
+        total += per_iter
+        rows.append((name, per_iter))
+    lines = [f"  {'op':<24} {'s/iter':>12} {'est. total (x{} iters)'.format(iters):>24}"]
+    for name, per_iter in rows:
+        lines.append(f"  {name:<24} {per_iter:>12.3e} {per_iter * iters:>24.3f}")
+    lines.append(f"  {'sum (unfused estimate)':<24} {total:>12.3e} {total * iters:>24.3f}")
+    return lines
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    problem = _problem(args)
+
+    if args.dtype == "float64" and args.backend != "native":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+    if args.backend == "native":
+        if args.profile:
+            raise SystemExit("--profile captures a JAX device trace; "
+                             "not available with --backend native")
+        if args.categories:
+            raise SystemExit("--categories times the JAX ops; "
+                             "not available with --backend native")
+        report, timer = _run_native(args, problem)
+    else:
+        backend = _pick_backend(args)
+        report, timer = _run_jax(args, problem, backend)
+
+    if args.json:
+        print(report.json_line())
+        return 0
+    print(report.table())
+    if args.backend != "native" and args.categories:
+        cat_dtype = "float64" if report.dtype == "float64" else "float32"
+        print("reconstructed per-op decomposition (production solve is fused):")
+        print("\n".join(_categories_table(problem, cat_dtype, report.iterations)))
+    if args.profile:
+        print(f"profiler trace written to {args.profile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

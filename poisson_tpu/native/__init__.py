@@ -56,10 +56,11 @@ def build(force: bool = False) -> str:
             # CI) may compile simultaneously; each writes its own file and
             # the os.replace is atomic.
             tmp = f"{_LIB}.{os.getpid()}.tmp"
-            cmd = [
-                "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-fopenmp",
-                _SRC, "-o", tmp,
-            ]
+            cxx = os.environ.get("CXX", "g++")
+            cxxflags = os.environ.get(
+                "CXXFLAGS", "-O2 -std=c++17 -fPIC -fopenmp"
+            ).split()
+            cmd = [cxx, *cxxflags, "-shared", _SRC, "-o", tmp]
             try:
                 proc = subprocess.run(cmd, capture_output=True, text=True)
                 if proc.returncode != 0:

@@ -1,0 +1,55 @@
+"""CLI driver: the reference's `main()` surface, as flags (SURVEY §7.6)."""
+
+import json
+
+import pytest
+
+from poisson_tpu.cli import build_parser, main
+
+
+def _json_line(capsys) -> dict:
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_xla_backend_json(capsys):
+    assert main(["40", "40", "--backend", "xla", "--json"]) == 0
+    rec = _json_line(capsys)
+    assert rec["iterations"] == 50
+    assert rec["final_diff"] < 1e-6
+    assert rec["l2_error"] < 5e-3
+
+
+def test_native_backend_json(capsys):
+    assert main(["40", "40", "--backend", "native", "--threads", "1",
+                 "--json"]) == 0
+    rec = _json_line(capsys)
+    assert rec["iterations"] == 50
+    assert rec["dtype"] == "float64"
+
+
+def test_sharded_backend_mesh(capsys):
+    assert main(["40", "40", "--backend", "sharded", "--mesh", "2x4",
+                 "--json"]) == 0
+    rec = _json_line(capsys)
+    assert rec["iterations"] == 50
+    assert rec["mesh"] == [2, 4]
+
+
+def test_unweighted_norm_flag(capsys):
+    assert main(["40", "40", "--backend", "xla", "--unweighted-norm",
+                 "--json"]) == 0
+    # stage0's unweighted norm: 61 in the fp64 oracle, 62 within one ulp.
+    assert _json_line(capsys)["iterations"] in (61, 62)
+
+
+def test_table_output_and_categories(capsys):
+    assert main(["40", "40", "--backend", "xla", "--categories"]) == 0
+    out = capsys.readouterr().out
+    assert "Iter=50" in out
+    assert "stencil (mat_A)" in out
+
+
+def test_bad_mesh_spec_rejected():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["40", "40", "--mesh", "banana"])

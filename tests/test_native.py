@@ -63,5 +63,7 @@ def test_native_openmp_thread_counts_agree():
 
 @pytest.mark.slow
 def test_native_golden_400x600():
+    # 4-thread reduction order is nondeterministic; the count is exact at a
+    # fixed order and can flip by one ulp otherwise (see thread-sweep test).
     r = native_solve(Problem(M=400, N=600), num_threads=4)
-    assert r.iterations == 546
+    assert abs(r.iterations - 546) <= 1
