@@ -18,7 +18,7 @@ def l2_error_vs_analytic(problem: Problem, w, xp=jnp):
 
     Outside D the fictitious-domain solution is O(ε)-small but nonzero by
     design, so the error is measured where the PDE actually holds.
-    ``xp=numpy`` serves jax-free callers (the native CLI backend)."""
+    ``xp=numpy`` keeps the computation on the host (no device transfer)."""
     u = analytic_solution(problem, dtype=w.dtype, xp=xp)
     i = xp.arange(problem.M + 1)
     j = xp.arange(problem.N + 1)

@@ -31,7 +31,7 @@ from typing import Optional
 import numpy as np
 
 from poisson_tpu.config import Problem
-from poisson_tpu.utils.timing import PhaseTimer, fence, mlups, solve_report
+from poisson_tpu.utils.timing import PhaseTimer, fence, solve_report
 
 
 def _parse_mesh(spec: str) -> tuple[int, int]:
@@ -88,8 +88,7 @@ def _problem(args) -> Problem:
 
 
 def _l2_error_np(problem: Problem, w: np.ndarray) -> float:
-    """Host-side (numpy) L2(D) error — no device round-trip, and serves the
-    jax-free native backend."""
+    """Host-side (numpy) L2(D) error — no device round-trip."""
     from poisson_tpu.analysis import l2_error_vs_analytic
 
     return float(
@@ -124,8 +123,8 @@ def _pick_backend(args) -> str:
     devices = jax.devices()
     if len(devices) > 1 or args.mesh is not None:
         return "sharded"
-    if devices[0].platform == "tpu":
-        return "pallas"
+    if devices[0].platform == "tpu" and args.dtype != "float64":
+        return "pallas"  # the fused path is fp32-only
     return "xla"
 
 
@@ -196,7 +195,7 @@ def _categories_table(problem: Problem, dtype, iters: int) -> list[str]:
     import jax
     import jax.numpy as jnp
 
-    from poisson_tpu.ops.stencil import apply_A, apply_Dinv, diag_D, dot_weighted
+    from poisson_tpu.ops.stencil import apply_A, apply_Dinv, dot_weighted
     from poisson_tpu.solvers.pcg import host_setup
 
     a, b, rhs, aux = host_setup(problem, jnp.dtype(dtype).name, False)
@@ -238,6 +237,8 @@ def _categories_table(problem: Problem, dtype, iters: int) -> list[str]:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     problem = _problem(args)
+    if args.categories and args.json:
+        raise SystemExit("--categories produces a table; drop --json")
 
     if args.dtype == "float64" and args.backend != "native":
         import jax

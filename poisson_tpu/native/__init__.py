@@ -56,11 +56,14 @@ def build(force: bool = False) -> str:
             # CI) may compile simultaneously; each writes its own file and
             # the os.replace is atomic.
             tmp = f"{_LIB}.{os.getpid()}.tmp"
+            # CXX/CXXFLAGS are overridable; the flags the shared library
+            # cannot link or load without are not.
             cxx = os.environ.get("CXX", "g++")
-            cxxflags = os.environ.get(
-                "CXXFLAGS", "-O2 -std=c++17 -fPIC -fopenmp"
-            ).split()
-            cmd = [cxx, *cxxflags, "-shared", _SRC, "-o", tmp]
+            cxxflags = os.environ.get("CXXFLAGS", "-O2").split()
+            cmd = [
+                cxx, *cxxflags, "-std=c++17", "-fPIC", "-fopenmp",
+                "-shared", _SRC, "-o", tmp,
+            ]
             try:
                 proc = subprocess.run(cmd, capture_output=True, text=True)
                 if proc.returncode != 0:

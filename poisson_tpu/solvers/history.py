@@ -1,0 +1,98 @@
+"""Fixed-budget diagnostic solve: per-iteration convergence history.
+
+The reference's final report plots the L2-error-vs-iteration curve as its
+accuracy control (``итоговый отчёт/Этап_4_1213.pdf`` p.1; no code survives —
+SURVEY §4.2). This module recreates that capability as a ``lax.scan`` over
+the shared PCG body (``solvers.pcg.make_pcg_body``): a fixed iteration
+budget, recording ‖Δw‖, ζ = (z, r), and optionally the L2(D) error against
+the analytic solution at every iteration — all device-resident, one fused
+program, no per-iteration host traffic.
+
+Once the δ-criterion (or a degenerate direction) fires, the state freezes:
+trailing scan steps are identity, so the recorded curve is flat after
+convergence and ``iterations`` matches :func:`solvers.pcg.pcg_solve`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from poisson_tpu.config import Problem
+from poisson_tpu.models.fictitious_domain import analytic_solution, is_in_domain
+from poisson_tpu.solvers.pcg import (
+    _select,
+    host_setup,
+    init_state,
+    make_pcg_body,
+    resolve_dtype,
+    resolve_scaled,
+    scaled_single_device_ops,
+    single_device_ops,
+)
+
+
+class HistoryResult(NamedTuple):
+    w: jnp.ndarray            # final solution, full grid, unscaled
+    iterations: jnp.ndarray   # iterations until convergence (or budget)
+    diffs: jnp.ndarray        # ‖w(k+1)−w(k)‖ per iteration, shape (budget,)
+    residual_dots: jnp.ndarray  # ζ per iteration
+    l2_errors: Optional[jnp.ndarray]  # L2(D) error per iteration (or None)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _history(problem: Problem, budget: int, scaled: bool, record_error: bool,
+             a, b, rhs, aux):
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    body = make_pcg_body(
+        ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+
+    if record_error:
+        dtype = rhs.dtype
+        u = analytic_solution(problem, dtype=dtype)
+        i = jnp.arange(problem.M + 1)
+        j = jnp.arange(problem.N + 1)
+        x = (problem.x_min + i.astype(dtype) * problem.h1)[:, None]
+        y = (problem.y_min + j.astype(dtype) * problem.h2)[None, :]
+        mask = is_in_domain(x, y)
+
+        def l2_err(w):
+            err2 = jnp.where(mask, (w - u) ** 2, 0.0)
+            return jnp.sqrt(jnp.sum(err2) * (problem.h1 * problem.h2))
+
+    def step(s, _):
+        s = _select(s.done, s, body(s))
+        w = s.w * aux if scaled else s.w
+        err = l2_err(w) if record_error else jnp.zeros((), rhs.dtype)
+        return s, (s.diff, s.zr, err)
+
+    s0 = init_state(ops, rhs)
+    final, (diffs, zrs, errs) = lax.scan(step, s0, None, length=budget)
+    w = final.w * aux if scaled else final.w
+    return w, final.k, diffs, zrs, errs
+
+
+def pcg_solve_history(problem: Problem, budget: int, dtype=None, scaled=None,
+                      record_error: bool = True) -> HistoryResult:
+    """Run exactly ``budget`` scan steps (iteration stops early only
+    logically — converged state freezes) and return per-iteration curves."""
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+    w, k, diffs, zrs, errs = _history(
+        problem, budget, use_scaled, record_error, a, b, rhs, aux
+    )
+    return HistoryResult(
+        w=w, iterations=k, diffs=diffs, residual_dots=zrs,
+        l2_errors=errs if record_error else None,
+    )

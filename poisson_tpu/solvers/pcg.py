@@ -106,9 +106,11 @@ def init_state(ops: PCGOps, rhs) -> PCGState:
     )
 
 
-def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
-             weighted_norm: bool, h1: float, h2: float) -> PCGState:
-    """Run the PCG while_loop to convergence; backend-agnostic."""
+def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
+                  h1: float, h2: float):
+    """One PCG iteration as a pure state→state function — shared by the
+    convergence ``while_loop`` (:func:`pcg_loop`) and the fixed-budget
+    diagnostic ``scan`` (``solvers.history``)."""
 
     def body(s: PCGState) -> PCGState:
         p = ops.exchange(s.p)
@@ -141,6 +143,16 @@ def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
         )
         kept = s._replace(k=s.k + 1, done=jnp.asarray(True))
         return _select(degenerate, kept, candidate)
+
+    return body
+
+
+def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
+             weighted_norm: bool, h1: float, h2: float) -> PCGState:
+    """Run the PCG while_loop to convergence; backend-agnostic."""
+    body = make_pcg_body(
+        ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2
+    )
 
     def cond(s: PCGState):
         return (~s.done) & (s.k < max_iter)

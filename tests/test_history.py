@@ -1,0 +1,46 @@
+"""Per-iteration history (the report's L2-error-vs-iteration curve)."""
+
+import numpy as np
+
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers.history import pcg_solve_history
+from poisson_tpu.solvers.pcg import pcg_solve
+
+
+def test_history_matches_solver():
+    p = Problem(M=40, N=40)
+    ref = pcg_solve(p)
+    h = pcg_solve_history(p, budget=60)
+    assert int(h.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(h.w), np.asarray(ref.w), rtol=0, atol=1e-12
+    )
+
+
+def test_history_curves_shape_and_freeze():
+    p = Problem(M=40, N=40)
+    h = pcg_solve_history(p, budget=60)
+    k = int(h.iterations)  # 50
+    assert h.diffs.shape == (60,)
+    # Frozen after convergence: tail equals the value at convergence.
+    np.testing.assert_array_equal(
+        np.asarray(h.diffs[k:]), np.asarray(h.diffs[k - 1])
+    )
+    # Final update norm is below delta, earlier ones above.
+    assert float(h.diffs[k - 1]) < p.delta < float(h.diffs[k - 2])
+
+
+def test_history_error_decreases_to_solver_accuracy():
+    p = Problem(M=40, N=40)
+    h = pcg_solve_history(p, budget=60)
+    errs = np.asarray(h.l2_errors)
+    # The error curve falls by >10x from start to convergence and ends at
+    # the discretisation level.
+    assert errs[0] / errs[-1] > 10
+    assert errs[-1] < 5e-3
+
+
+def test_history_without_error_recording():
+    h = pcg_solve_history(Problem(M=20, N=20), budget=40, record_error=False)
+    assert h.l2_errors is None
+    assert int(h.iterations) > 0
