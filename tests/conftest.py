@@ -29,10 +29,20 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running solve (large grids)")
+    config.addinivalue_line(
+        "markers",
+        "xslow: minutes-long solve (largest grids); skipped unless "
+        "RUN_XSLOW=1 or selected with -m xslow",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
-    if config.getoption("-m", default=""):
+    markexpr = config.getoption("-m", default="")
+    if "xslow" in markexpr or os.environ.get("RUN_XSLOW") == "1":
         return
-    # slow tests run by default (they are the golden-count regressions) but
-    # can be skipped with `-m 'not slow'`.
+    # slow tests run by default (they are the golden-count regressions);
+    # xslow (the 1600×2400 / 2400×3200 goldens, ~2-3 min each) only on demand.
+    skip = pytest.mark.skip(reason="xslow: set RUN_XSLOW=1 or -m xslow")
+    for item in items:
+        if "xslow" in item.keywords:
+            item.add_marker(skip)

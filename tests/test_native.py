@@ -67,3 +67,16 @@ def test_native_golden_400x600():
     # fixed order and can flip by one ulp otherwise (see thread-sweep test).
     r = native_solve(Problem(M=400, N=600), num_threads=4)
     assert abs(r.iterations - 546) <= 1
+
+
+@pytest.mark.xslow
+@pytest.mark.parametrize(
+    "M,N,expected", [(1600, 2400, 1858), (2400, 3200, 2449)]
+)
+def test_native_golden_largest_grids(M, N, expected):
+    """The two largest published grids (BASELINE.md, Этап_4_1213.pdf
+    Table 1). ~2-3 min each on CPU."""
+    import os
+
+    r = native_solve(Problem(M=M, N=N), num_threads=os.cpu_count())
+    assert abs(r.iterations - expected) <= 1

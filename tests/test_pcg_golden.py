@@ -47,6 +47,16 @@ def test_golden_iterations_large(M, N, expected):
     assert int(r.iterations) == expected
 
 
+@pytest.mark.xslow
+def test_fp32_scaled_golden_1600x2400():
+    """Precision policy at the reference's second-largest grid: fp32 on the
+    scaled system must stay within one iteration of the fp64 oracle's 1858
+    (SURVEY §7.3's hardest correctness risk)."""
+    r = pcg_solve(Problem(M=1600, N=2400), dtype=jnp.float32)
+    assert abs(int(r.iterations) - 1858) <= 1
+    assert float(r.diff) < 1e-6
+
+
 def _l2_error_inside(p: Problem, w) -> float:
     """L2(D) error vs u = (1−x²−4y²)/10, interior ellipse nodes only
     (the reference's analytic accuracy control, SURVEY §4.2)."""
