@@ -7,11 +7,11 @@ Baseline: the reference's stage4 MPI+CUDA single-GPU (Tesla P100) result on
 the same 800×1200 grid — 989 iterations in 0.83 s ⇒ ≈1141 MLUPS
 (BASELINE.md, Этап_4_1213.pdf Table 1). vs_baseline = ours / 1141.
 
-Backend selection: on a single TPU chip, the fused Pallas path
-(ops.pallas_cg — two HBM sweeps per iteration, measured ~1.3× the XLA-fused
-path); elsewhere the pure-JAX path, sharded over all local devices when
-there are several. A backend failure falls back to the XLA path so the
-harness always gets a number.
+Backend selection: on TPU, the fused Pallas path (ops.pallas_cg — two HBM
+sweeps per iteration, measured ~1.3× the XLA-fused path), sharded over all
+chips when there are several (parallel.pallas_sharded); on other platforms
+the pure-JAX path (sharded when multi-device). A backend failure falls
+back to the XLA path so the harness always gets a number.
 
 Timing methodology. Two artifacts of the tunneled platform have to be
 engineered out (utils.timing.fence): fetching any fresh output costs a
@@ -57,12 +57,24 @@ def main() -> int:
 
     backend = "xla"
     run = xla_run
-    if platform == "tpu" and len(devices) == 1:
+    if platform == "tpu":
         try:
-            from poisson_tpu.ops.pallas_cg import pallas_cg_solve
+            if len(devices) == 1:
+                from poisson_tpu.ops.pallas_cg import pallas_cg_solve
 
-            run = lambda gate=None: pallas_cg_solve(problem, rhs_gate=gate)
-            backend = "pallas_fused"
+                run = lambda gate=None: pallas_cg_solve(problem, rhs_gate=gate)
+                backend = "pallas_fused"
+            else:
+                from poisson_tpu.parallel import (
+                    make_solver_mesh,
+                    pallas_cg_solve_sharded,
+                )
+
+                mesh = make_solver_mesh(devices)
+                run = lambda gate=None: pallas_cg_solve_sharded(
+                    problem, mesh, rhs_gate=gate
+                )
+                backend = "pallas_sharded"
         except Exception:
             backend = "xla"
             run = xla_run
@@ -73,7 +85,7 @@ def main() -> int:
     try:
         result = run()
         fence(result)
-        if backend == "pallas_fused" and not 900 < int(result.iterations) < 1100:
+        if backend.startswith("pallas") and not 900 < int(result.iterations) < 1100:
             raise RuntimeError(f"suspect iterations {int(result.iterations)}")
     except Exception:
         if backend == "xla":

@@ -60,9 +60,10 @@ def _parse_args(argv):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--grids", default="40x40,400x600,800x1200")
     p.add_argument("--backends", default="auto",
-                   help="comma list of xla,pallas,sharded,native; 'auto' = "
-                        "xla+native, plus sharded when >1 device, plus "
-                        "pallas on TPU")
+                   help="comma list of xla,pallas,sharded,pallas-sharded,"
+                        "native; 'auto' = xla+native, plus sharded when >1 "
+                        "device, plus pallas (and pallas-sharded when >1 "
+                        "device) on TPU")
     p.add_argument("--meshes", default=None,
                    help="comma list like 1x1,2x2,2x4 (sharded rows; default: "
                         "near-square over all devices)")
@@ -139,6 +140,8 @@ def main(argv=None) -> int:
             backends.append("sharded")
         if platform == "tpu":
             backends.append("pallas")
+            if len(devices) > 1:
+                backends.append("pallas-sharded")
     else:
         backends = args.backends.split(",")
 
@@ -169,9 +172,10 @@ def main(argv=None) -> int:
                                    args.repeat)
                 rows.append(_row("pallas", "1 dev fused", problem,
                                  int(res.iterations), best, l2(problem, res.w)))
-            elif backend == "sharded":
+            elif backend in ("sharded", "pallas-sharded"):
                 from poisson_tpu.parallel import (
                     make_solver_mesh,
+                    pallas_cg_solve_sharded,
                     pcg_solve_sharded,
                 )
 
@@ -186,11 +190,12 @@ def main(argv=None) -> int:
                     )
                     mesh = make_solver_mesh(subset, grid=shape)
                     px, py = mesh.shape["x"], mesh.shape["y"]
-                    res, best = _timed(
-                        lambda: pcg_solve_sharded(problem, mesh), fence,
-                        args.repeat,
-                    )
-                    rows.append(_row("sharded", f"mesh {px}x{py} ({platform})",
+                    if backend == "pallas-sharded":
+                        run = lambda: pallas_cg_solve_sharded(problem, mesh)
+                    else:
+                        run = lambda: pcg_solve_sharded(problem, mesh)
+                    res, best = _timed(run, fence, args.repeat)
+                    rows.append(_row(backend, f"mesh {px}x{py} ({platform})",
                                      problem, int(res.iterations), best,
                                      l2(problem, res.w)))
             elif backend == "native":

@@ -56,9 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-iter", type=int, default=None,
                    help="iteration cap (default (M-1)(N-1))")
     p.add_argument("--backend",
-                   choices=("auto", "xla", "pallas", "sharded", "native"),
+                   choices=("auto", "xla", "pallas", "sharded",
+                            "pallas-sharded", "native"),
                    default="auto",
-                   help="auto: sharded if >1 device, pallas on 1 TPU, else xla")
+                   help="auto: pallas-sharded on >1 TPU, sharded on >1 CPU "
+                        "device, pallas on 1 TPU, else xla")
     p.add_argument("--mesh", type=_parse_mesh, default=None, metavar="PXxPY",
                    help="device mesh shape for --backend sharded (default: "
                         "near-square over all devices)")
@@ -121,10 +123,15 @@ def _pick_backend(args) -> str:
     if args.backend != "auto":
         return args.backend
     devices = jax.devices()
+    tpu = devices[0].platform == "tpu"
     if len(devices) > 1 or args.mesh is not None:
+        # pallas-sharded builds its canvases on the host; an explicit
+        # --setup device request keeps the XLA sharded path.
+        if tpu and args.dtype != "float64" and args.setup != "device":
+            return "pallas-sharded"
         return "sharded"
-    if devices[0].platform == "tpu" and args.dtype != "float64":
-        return "pallas"  # the fused path is fp32-only
+    if tpu and args.dtype != "float64":
+        return "pallas"  # the fused paths are fp32-only
     return "xla"
 
 
@@ -135,14 +142,30 @@ def _run_jax(args, problem: Problem, backend: str):
     mesh_shape: Optional[tuple[int, int]] = None
     devices = jax.devices()
 
-    if backend == "sharded":
-        from poisson_tpu.parallel import make_solver_mesh, pcg_solve_sharded
-
-        mesh = make_solver_mesh(grid=args.mesh)
-        mesh_shape = (mesh.shape["x"], mesh.shape["y"])
-        run = lambda: pcg_solve_sharded(
-            problem, mesh, dtype=args.dtype, setup=args.setup
+    if backend in ("sharded", "pallas-sharded"):
+        from poisson_tpu.parallel import (
+            make_solver_mesh,
+            pallas_cg_solve_sharded,
+            pcg_solve_sharded,
         )
+
+        if args.mesh is not None:
+            n_sub = args.mesh[0] * args.mesh[1]
+            mesh = make_solver_mesh(devices[:n_sub], grid=args.mesh)
+        else:
+            mesh = make_solver_mesh()
+        mesh_shape = (mesh.shape["x"], mesh.shape["y"])
+        if backend == "pallas-sharded":
+            if args.dtype == "float64":
+                raise SystemExit(
+                    "--backend pallas-sharded is the fp32 fused path; use "
+                    "--backend sharded for float64"
+                )
+            run = lambda: pallas_cg_solve_sharded(problem, mesh)
+        else:
+            run = lambda: pcg_solve_sharded(
+                problem, mesh, dtype=args.dtype, setup=args.setup
+            )
         n_dev = mesh_shape[0] * mesh_shape[1]
     elif backend == "pallas":
         if args.dtype == "float64":
@@ -177,7 +200,11 @@ def _run_jax(args, problem: Problem, backend: str):
 
     from poisson_tpu.solvers.pcg import resolve_dtype
 
-    dtype_name = "float32" if backend == "pallas" else resolve_dtype(args.dtype)
+    dtype_name = (
+        "float32"
+        if backend in ("pallas", "pallas-sharded")
+        else resolve_dtype(args.dtype)
+    )
     report = solve_report(
         problem, result, best,
         compile_seconds=timer.times["compile_and_first_solve"] - best,

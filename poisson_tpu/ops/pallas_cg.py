@@ -113,6 +113,25 @@ def canvas_spec(problem: Problem, bm: int | None = None) -> Canvas:
                   cols=canvas_cols(problem))
 
 
+def scaled_stencil_fields(problem: Problem):
+    """Grid-indexed folded-scaling stencil fields (host fp64, numpy).
+
+    Returns (gcs, gcw, sc2, rhs, sc) on the full (M+1, N+1) grid:
+        gcs[i, j] = a[i,j]·sc[i,j]·sc[i−1,j]/h1²   (south edge, i ≥ 1)
+        gcw[i, j] = b[i,j]·sc[i,j]·sc[i,j−1]/h2²   (west edge,  j ≥ 1)
+    with row/column 0 zeroed, sc2 = sc², rhs = b̃ = sc·B, sc = D^{-1/2}
+    (zero ring). Shared derivation for the single-device and sharded canvas
+    builders — the kernels' operator comes from exactly one place.
+    """
+    a64, b64, rhs64, sc64 = host_fields64(problem, True)
+    h1sq, h2sq = problem.h1 ** 2, problem.h2 ** 2
+    gcs = np.zeros_like(a64)
+    gcs[1:, :] = a64[1:, :] * sc64[1:, :] * sc64[:-1, :] / h1sq
+    gcw = np.zeros_like(b64)
+    gcw[:, 1:] = b64[:, 1:] * sc64[:, 1:] * sc64[:, :-1] / h2sq
+    return gcs, gcw, sc64 * sc64, rhs64, sc64
+
+
 @functools.lru_cache(maxsize=8)
 def build_canvases(problem: Problem, bm: int | None = None,
                    dtype_name: str = "float32"):
@@ -135,7 +154,7 @@ def build_canvases(problem: Problem, bm: int | None = None,
     cv = canvas_spec(problem, bm)
     dtype = jnp.dtype(dtype_name)
     M, N = problem.M, problem.N
-    a64, b64, rhs64, sc64 = host_fields64(problem, True)  # sc64: D^{-1/2}, zero ring
+    gcs, gcw, sc2_64, rhs64, sc64 = scaled_stencil_fields(problem)
 
     def to_canvas(grid_rows_1_to_M: np.ndarray, col0: int = 0) -> np.ndarray:
         """Embed rows 1..M(−1) of a full (M+1,N+1) grid at canvas row HALO+…"""
@@ -144,15 +163,12 @@ def build_canvases(problem: Problem, bm: int | None = None,
         out[HALO : HALO + nr, col0 : col0 + nc] = grid_rows_1_to_M
         return out
 
-    h1sq, h2sq = problem.h1 ** 2, problem.h2 ** 2
     # Edge coefficients for i = 1..M (row i=M closes the last interior
     # point's north edge; it is zero anyway since sc[M,:] = 0).
-    cs = a64[1:, :] * sc64[1:, :] * sc64[:-1, :] / h1sq          # (M, N+1)
-    cw = b64[:, 1:] * sc64[:, 1:] * sc64[:, :-1] / h2sq          # (M+1, N)
-    cs_canvas = to_canvas(cs)
-    cw_canvas = to_canvas(cw[1:, :], col0=1)                      # rows 1..M
+    cs_canvas = to_canvas(gcs[1:, :])
+    cw_canvas = to_canvas(gcw[1:, 1:], col0=1)                    # rows 1..M
     rhs_canvas = to_canvas(rhs64[1:M, :])                         # b̃, rows 1..M-1
-    sc2_canvas = to_canvas((sc64 * sc64)[1:M, :])
+    sc2_canvas = to_canvas(sc2_64[1:M, :])
 
     as_dev = lambda x: jnp.asarray(x, dtype)
     return (
@@ -175,7 +191,8 @@ def _shift_col_plus(u):
     return jnp.concatenate([u[:, 1:], jnp.zeros_like(u[:, :1])], axis=1)
 
 
-def _make_direction_stencil_kernel(cv: Canvas):
+def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
+                                   masked: bool):
     """Kernel A: p ← z + β·p, Ap ← Ãp, accumulate ⟨Ap, p⟩.
 
     Strip refs are (BM+2·HALO, C) halo-inclusive; outputs are the BM center
@@ -183,18 +200,33 @@ def _make_direction_stencil_kernel(cv: Canvas):
     are the neighbouring strips' center rows), trading 2·C flops per strip
     for not re-reading p after the update — the fused-CG restructuring.
 
+    ``band`` is the canvas-row range [lo, hi) on which the direction update
+    is live. Single-device: the interior strips (the Dirichlet ring stays
+    zero). Sharded (``parallel.pallas_sharded``): widened by one row per
+    side, so the shard's halo rows — whose z/p values neighbours own —
+    are recomputed in-register for the stencil, the same values the
+    neighbour computes for its own edge (no p exchange).
+
+    ``masked`` adds a (1, C) column-mask operand multiplying the ⟨Ap, p⟩
+    partial: sharded canvases carry real (neighbour) values in their halo
+    columns, which must not enter the owned-interior reduction. The
+    single-device canvas is zero there by construction and needs no mask.
+
     p's guard blocks are uninitialized garbage (the output is a fresh buffer
     whose guards are never written — it must NOT alias the p input: with the
     buffers unified, a strip's halo read would see the rows the *previous*
     grid step already overwrote). Zero coefficients would absorb finite
     garbage, but not NaN/Inf, so the strip is explicitly zeroed outside the
-    written band [BM, (nb+1)·BM) right where it is computed.
+    live band right where it is computed.
     """
     h = HALO
-    band_lo, band_hi = h, cv.rows - h
+    band_lo, band_hi = band
 
-    def kernel(beta_ref, z_ref, p_ref, cs_ref, cw_ref,
-               pn_ref, ap_ref, denom_ref):
+    def kernel(beta_ref, z_ref, p_ref, cs_ref, cw_ref, *rest):
+        if masked:
+            colmask_ref, pn_ref, ap_ref, denom_ref = rest
+        else:
+            pn_ref, ap_ref, denom_ref = rest
         i = pl.program_id(0)
         beta = beta_ref[0, 0]
         off = i * cv.bm
@@ -216,7 +248,10 @@ def _make_direction_stencil_kernel(cv: Canvas):
         pn_ref[:] = c
         ap_ref[:] = ap
 
-        part = jnp.sum(ap * c, dtype=jnp.float32)
+        apc = ap * c
+        if masked:
+            apc = apc * colmask_ref[:]
+        part = jnp.sum(apc, dtype=jnp.float32)
 
         @pl.when(i == 0)
         def _():
@@ -227,25 +262,40 @@ def _make_direction_stencil_kernel(cv: Canvas):
     return kernel
 
 
-def _update_kernel(alpha_ref, p_ref, ap_ref, sc2_ref, w_ref, r_ref,
-                   w_out_ref, r_out_ref, diff_ref, zr_ref):
-    """Kernel B: w ← w + α·p, r ← r − α·Ap, accumulate Σp²·sc² and Σr²."""
-    i = pl.program_id(0)
-    alpha = alpha_ref[0, 0]
-    p = p_ref[:]
-    r_new = r_ref[:] - alpha * ap_ref[:]
-    w_out_ref[:] = w_ref[:] + alpha * p
-    r_out_ref[:] = r_new
-    d_part = jnp.sum(p * p * sc2_ref[:], dtype=jnp.float32)
-    z_part = jnp.sum(r_new * r_new, dtype=jnp.float32)
+def _make_update_kernel(masked: bool):
+    """Kernel B: w ← w + α·p, r ← r − α·Ap, accumulate Σp²·sc² and Σr².
 
-    @pl.when(i == 0)
-    def _():
-        diff_ref[0, 0] = 0.0
-        zr_ref[0, 0] = 0.0
+    ``masked`` adds a (1, C) column mask multiplying the Σr² partial (the
+    sharded canvases hold real neighbour values in halo columns); the
+    Σp²·sc² partial needs no mask because the sharded sc2 canvas is
+    pre-zeroed outside the owned interior."""
 
-    diff_ref[0, 0] += d_part
-    zr_ref[0, 0] += z_part
+    def kernel(alpha_ref, p_ref, ap_ref, sc2_ref, *rest):
+        if masked:
+            colmask_ref, w_ref, r_ref, w_out_ref, r_out_ref, diff_ref, zr_ref = rest
+        else:
+            w_ref, r_ref, w_out_ref, r_out_ref, diff_ref, zr_ref = rest
+        i = pl.program_id(0)
+        alpha = alpha_ref[0, 0]
+        p = p_ref[:]
+        r_new = r_ref[:] - alpha * ap_ref[:]
+        w_out_ref[:] = w_ref[:] + alpha * p
+        r_out_ref[:] = r_new
+        d_part = jnp.sum(p * p * sc2_ref[:], dtype=jnp.float32)
+        rr = r_new * r_new
+        if masked:
+            rr = rr * colmask_ref[:]
+        z_part = jnp.sum(rr, dtype=jnp.float32)
+
+        @pl.when(i == 0)
+        def _():
+            diff_ref[0, 0] = 0.0
+            zr_ref[0, 0] = 0.0
+
+        diff_ref[0, 0] += d_part
+        zr_ref[0, 0] += z_part
+
+    return kernel
 
 
 def _strip_in_spec(cv: Canvas):
@@ -279,18 +329,35 @@ def _canvas_shape(cv: Canvas, dtype):
     return jax.ShapeDtypeStruct((cv.rows, cv.cols), dtype)
 
 
-def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, *, interpret: bool):
-    """p_new, Ap, Σ Ap·p_new (unweighted) — one HBM sweep."""
+def _colmask_spec(cv: Canvas):
+    """(1, C) row broadcast to every strip."""
+    return pl.BlockSpec((1, cv.cols), lambda i: (0, 0))
+
+
+def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, *, interpret: bool,
+                          band: tuple[int, int] | None = None, colmask=None):
+    """p_new, Ap, Σ Ap·p_new (unweighted) — one HBM sweep.
+
+    ``band``/``colmask`` select the sharded variant (see the kernel factory);
+    defaults are the single-device interior band with no mask."""
+    if band is None:
+        band = (HALO, cv.rows - HALO)
+    masked = colmask is not None
+    in_specs = [
+        _scalar_spec(),
+        _strip_in_spec(cv),   # z: halo rows feed the stencil
+        _strip_in_spec(cv),   # p: ditto
+        _strip_in_spec(cv),   # cs: needs rows up to center+1
+        _block_spec(cv),      # cw: only center rows are read
+    ]
+    operands = [beta, z, p, cs, cw]
+    if masked:
+        in_specs.append(_colmask_spec(cv))
+        operands.append(colmask)
     return pl.pallas_call(
-        _make_direction_stencil_kernel(cv),
+        _make_direction_stencil_kernel(cv, band, masked),
         grid=(cv.nb,),
-        in_specs=[
-            _scalar_spec(),
-            _strip_in_spec(cv),   # z: halo rows feed the stencil
-            _strip_in_spec(cv),   # p: ditto
-            _strip_in_spec(cv),   # cs: needs rows up to center+1
-            _block_spec(cv),      # cw: only center rows are read
-        ],
+        in_specs=in_specs,
         out_specs=[_block_spec(cv), _block_spec(cv), _scalar_spec()],
         out_shape=[
             _canvas_shape(cv, p.dtype),
@@ -298,22 +365,30 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, *, interpret: bool):
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(beta, z, p, cs, cw)
+    )(*operands)
 
 
-def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool):
+def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
+                 colmask=None):
     """w', r', Σ p²·sc², Σ r'² — one HBM sweep."""
+    masked = colmask is not None
+    in_specs = [
+        _scalar_spec(),
+        _block_spec(cv),
+        _block_spec(cv),
+        _block_spec(cv),
+    ]
+    operands = [alpha, p, ap, sc2]
+    if masked:
+        in_specs.append(_colmask_spec(cv))
+        operands.append(colmask)
+    w_idx = len(operands)
+    in_specs += [_block_spec(cv), _block_spec(cv)]
+    operands += [w, r]
     return pl.pallas_call(
-        _update_kernel,
+        _make_update_kernel(masked),
         grid=(cv.nb,),
-        in_specs=[
-            _scalar_spec(),
-            _block_spec(cv),
-            _block_spec(cv),
-            _block_spec(cv),
-            _block_spec(cv),
-            _block_spec(cv),
-        ],
+        in_specs=in_specs,
         out_specs=[
             _block_spec(cv),
             _block_spec(cv),
@@ -326,9 +401,9 @@ def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool):
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
-        input_output_aliases={4: 0, 5: 1},  # w → w', r → r'
+        input_output_aliases={w_idx: 0, w_idx + 1: 1},  # w → w', r → r'
         interpret=interpret,
-    )(alpha, p, ap, sc2, w, r)
+    )(*operands)
 
 
 class _FusedState(NamedTuple):

@@ -1,4 +1,10 @@
 from poisson_tpu.parallel.mesh import choose_process_grid, make_solver_mesh
+from poisson_tpu.parallel.pallas_sharded import pallas_cg_solve_sharded
 from poisson_tpu.parallel.pcg_sharded import pcg_solve_sharded
 
-__all__ = ["choose_process_grid", "make_solver_mesh", "pcg_solve_sharded"]
+__all__ = [
+    "choose_process_grid",
+    "make_solver_mesh",
+    "pallas_cg_solve_sharded",
+    "pcg_solve_sharded",
+]

@@ -1,0 +1,303 @@
+"""Distributed fused-Pallas PCG: stage4's full combination, TPU-native.
+
+The reference's final stage pairs accelerator kernels with distribution
+(MPI+CUDA, ``stage4-mpi+cuda/poisson_mpi_cuda_f.cu:688-983``): CUDA kernels
+per rank, host-staged halo exchange on the search direction p, Allreduce'd
+scalars. This module is that combination re-designed for a TPU pod: the
+fused two-sweep Pallas iteration (``ops.pallas_cg``) runs per shard inside
+``shard_map`` over a 2D mesh, with ``ppermute`` halos and ``psum`` scalars.
+
+**The halo exchange moves from p to r.** The reference refreshes p's ghost
+ring every iteration because the stencil consumes p. But in the fused
+restructuring the direction update ``p ← z + β·p`` runs *inside* the
+stencil sweep, so a shard can compute its neighbour's edge values of the
+new p by itself — z (= r on the scaled system) and the old p at the halo
+ring suffice, and β is mesh-replicated. By induction the p halos stay
+fresh without ever being communicated, provided r's halo ring is refreshed
+once per iteration (r's halo cannot be recomputed locally: it would need a
+second ghost ring for Ap). Per iteration the wire traffic is therefore the
+same as the reference's — four thin ``ppermute`` slices (of r, not p) and
+three ``psum`` scalars — while the arithmetic stays two HBM sweeps.
+
+Shard canvas layout (cf. the single-device canvas, ``ops.pallas_cg``):
+
+  - the shard owns m̂ interior rows × n̂ interior columns, with
+    m̂ = ⌈(M−1)/Px⌉ rounded up to a multiple of the strip height bm (so the
+    strip grid tiles the owned band exactly and the halo rows fall in the
+    guard bands, outside every kernel reduction);
+  - canvas row HALO+li ↔ global grid row ix·m̂+1+li; canvas column lj ↔
+    global grid column iy·n̂+lj (column 0 / n̂+1 are the halo columns);
+  - halo *columns* live inside the summed band, so kernel reductions take a
+    (1, C) column mask; halo *rows* sit outside the written band and the
+    guard rows are absorbed by the kernels' band gating;
+  - canvas columns beyond n̂+1 (lane padding) are zeroed in every
+    coefficient canvas — on a shard they would otherwise alias a further
+    neighbour's data (the global grid continues past the halo).
+
+Correctness of the zero-padded decomposition follows the same induction as
+``parallel.pcg_sharded``: padded rows/columns have zero scaled coefficients
+and zero RHS, so p, Ap, r stay identically zero there through every sweep.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from poisson_tpu.config import Problem
+from poisson_tpu.ops.pallas_cg import (
+    HALO,
+    LANE,
+    SUBLANE,
+    VMEM_BUDGET,
+    Canvas,
+    direction_and_stencil,
+    fused_update,
+    scaled_stencil_fields,
+)
+from poisson_tpu.parallel.mesh import X_AXIS, Y_AXIS
+from poisson_tpu.solvers.pcg import PCGResult, _DENOM_TOL
+
+_AXES = (X_AXIS, Y_AXIS)
+
+
+class ShardSpec(NamedTuple):
+    """Static per-shard canvas geometry (hashable; jit static arg)."""
+
+    cv: Canvas
+    m_blk: int   # owned interior rows per shard (= cv.nb · cv.bm)
+    n_blk: int   # owned interior cols per shard
+
+
+def shard_spec(problem: Problem, px: int, py: int,
+               bm: int | None = None) -> ShardSpec:
+    n_blk = -(-(problem.N - 1) // py)
+    cols = ((n_blk + 2 + LANE - 1) // LANE) * LANE
+    if bm is None:
+        rows_budget = VMEM_BUDGET // (12 * cols * 4)
+        owned = -(-(problem.M - 1) // px)
+        owned_cap = -(-owned // SUBLANE) * SUBLANE  # don't sweep past owned rows
+        bm = max(SUBLANE,
+                 (min(rows_budget, 128, owned_cap) // SUBLANE) * SUBLANE)
+    if bm <= 0 or bm % SUBLANE != 0:
+        raise ValueError(f"bm must be a positive multiple of {SUBLANE}, got {bm}")
+    # Owned rows rounded up to the strip height: strips tile the owned band
+    # exactly, so the halo rows stay outside every kernel reduction.
+    m_min = -(-(problem.M - 1) // px)
+    nb = -(-m_min // bm)
+    m_blk = nb * bm
+    cv = Canvas(bm=bm, nb=nb, rows=nb * bm + 2 * HALO, cols=cols)
+    return ShardSpec(cv=cv, m_blk=m_blk, n_blk=n_blk)
+
+
+@functools.lru_cache(maxsize=8)
+def _shard_canvases(problem: Problem, px: int, py: int, spec: ShardSpec,
+                    dtype_name: str):
+    """Host fp64 setup → stacked per-shard canvases (mesh order, x-major).
+
+    Returns (cs, cw, rhs, sc2) of shape (P, R, C), sc_int of shape
+    (P, m̂, n̂) for solution extraction, and the (1, C) column mask."""
+    cv = spec.cv
+    m_blk, n_blk = spec.m_blk, spec.n_blk
+    dtype = jnp.dtype(dtype_name)
+    M, N = problem.M, problem.N
+
+    gcs, gcw, sc2_64, rhs64, sc64 = scaled_stencil_fields(problem)
+
+    # One zero-padded global scratch big enough for every shard's
+    # (row0 + canvas extent) slice; canvas row HALO-1 maps to global grid
+    # row ix·m̂, canvas col 0 to global grid col iy·n̂.
+    height = (px - 1) * m_blk + (cv.rows - (HALO - 1)) + 1
+    width = (py - 1) * n_blk + cv.cols + 1
+    big = np.zeros((max(height, M + 1), max(width, N + 1)), np.float64)
+
+    def stacked(field, zero_pad_cols: bool, zero_halo_cols: bool = False):
+        big[:] = 0.0
+        big[: M + 1, : N + 1] = field
+        out = np.zeros((px * py, cv.rows, cv.cols), np.float64)
+        for ix in range(px):
+            for iy in range(py):
+                sl = big[
+                    ix * m_blk : ix * m_blk + cv.rows - (HALO - 1),
+                    iy * n_blk : iy * n_blk + cv.cols,
+                ]
+                out[ix * py + iy, HALO - 1 :, :] = sl
+        if zero_pad_cols:
+            out[:, :, n_blk + 2 :] = 0.0
+        if zero_halo_cols:
+            out[:, :, 0] = 0.0
+            out[:, :, n_blk + 1] = 0.0
+        return jnp.asarray(out, dtype)
+
+    cs_st = stacked(gcs, zero_pad_cols=True)
+    cw_st = stacked(gcw, zero_pad_cols=True)
+    # rhs keeps real values in its halo ring: that ring seeds r's (and via
+    # p0 = r0, p's) fresh halos at iteration 0.
+    rhs_st = stacked(rhs64, zero_pad_cols=True)
+    # sc2 is a pure reduction weight: restrict it to the owned interior.
+    sc2_st = stacked(sc2_64, zero_pad_cols=True, zero_halo_cols=True)
+
+    sc_int = np.zeros((px * py, m_blk, n_blk), np.float64)
+    for ix in range(px):
+        for iy in range(py):
+            blk = sc64[
+                1 + ix * m_blk : 1 + ix * m_blk + m_blk,
+                1 + iy * n_blk : 1 + iy * n_blk + n_blk,
+            ]
+            sc_int[ix * py + iy, : blk.shape[0], : blk.shape[1]] = blk
+    sc_int = jnp.asarray(sc_int, dtype)
+
+    colmask = np.zeros((1, cv.cols), np.float64)
+    colmask[0, 1 : n_blk + 1] = 1.0
+    return cs_st, cw_st, rhs_st, sc2_st, sc_int, jnp.asarray(colmask, dtype)
+
+
+class _State(NamedTuple):
+    k: jnp.ndarray
+    done: jnp.ndarray
+    w: jnp.ndarray
+    r: jnp.ndarray
+    p: jnp.ndarray
+    zr: jnp.ndarray
+    beta: jnp.ndarray
+    diff: jnp.ndarray
+
+
+def _exchange_r_halo(r, spec: ShardSpec, px: int, py: int):
+    """Refresh r's halo ring: 4 thin ppermute slices (the reference's four
+    MPI messages, ``stage2:…cpp:241-347`` — but of r, see module doc).
+    Mesh-edge shards receive ppermute's zero fill = Dirichlet data."""
+    from poisson_tpu.parallel.halo import _shift_down, _shift_up
+
+    lo, hi = HALO, HALO + spec.m_blk
+    top = _shift_down(r[hi - 1, :], X_AXIS, px)
+    bot = _shift_up(r[lo, :], X_AXIS, px)
+    r = r.at[lo - 1, :].set(top).at[hi, :].set(bot)
+    left = _shift_down(r[:, spec.n_blk], Y_AXIS, py)
+    right = _shift_up(r[:, 1], Y_AXIS, py)
+    return r.at[:, 0].set(left).at[:, spec.n_blk + 1].set(right)
+
+
+def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
+               interpret: bool, cs, cw, rhs, sc2, sc_int, colmask):
+    cv = spec.cv
+    dtype = rhs.dtype
+    h1h2 = jnp.float32(problem.h1 * problem.h2)
+    norm_w = h1h2 if problem.weighted_norm else jnp.float32(1.0)
+    band = (HALO - 1, HALO + spec.m_blk + 1)  # owned rows + halo ring
+    lo, hi = HALO, HALO + spec.m_blk
+
+    def psum(x):
+        return lax.psum(x, _AXES)
+
+    def body(s: _State) -> _State:
+        beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
+        pn, ap, denom_part = direction_and_stencil(
+            cv, beta, s.r, s.p, cs, cw, interpret=interpret,
+            band=band, colmask=colmask,
+        )
+        # Halo rows of the new direction: identical to what the row
+        # neighbour computed for its own edge (z = r and old-p halos are
+        # fresh, β is replicated). Halo *columns* were computed in-sweep.
+        b = s.beta.astype(dtype)
+        pn = pn.at[lo - 1, :].set(s.r[lo - 1, :] + b * s.p[lo - 1, :])
+        pn = pn.at[hi, :].set(s.r[hi, :] + b * s.p[hi, :])
+
+        denom = psum(denom_part[0, 0]) * h1h2
+        degenerate = jnp.abs(denom) < _DENOM_TOL
+        alpha32 = jnp.where(
+            degenerate, 0.0, s.zr / jnp.where(degenerate, 1.0, denom)
+        )
+        alpha = jnp.reshape(alpha32, (1, 1)).astype(dtype)
+
+        w, r, diff_part, zr_part = fused_update(
+            cv, alpha, pn, ap, sc2, s.w, s.r, interpret=interpret,
+            colmask=colmask,
+        )
+        diff = jnp.abs(alpha32) * jnp.sqrt(psum(diff_part[0, 0]) * norm_w)
+        zr_new = psum(zr_part[0, 0]) * h1h2
+        converged = diff < problem.delta
+
+        r = _exchange_r_halo(r, spec, px, py)
+        return _State(
+            k=s.k + 1,
+            done=degenerate | converged,
+            w=w, r=r, p=pn,
+            zr=zr_new,
+            beta=zr_new / jnp.where(s.zr == 0.0, 1.0, s.zr),
+            diff=diff,
+        )
+
+    def cond(s: _State):
+        return (~s.done) & (s.k < problem.iteration_cap)
+
+    zeros = jnp.zeros((cv.rows, cv.cols), dtype)
+    center = rhs[lo:hi, :].astype(jnp.float32)
+    zr0 = psum(jnp.sum(center * center * colmask.astype(jnp.float32))) * h1h2
+    init = _State(
+        k=jnp.zeros((), jnp.int32),
+        done=jnp.asarray(False),
+        w=zeros, r=rhs, p=zeros,
+        zr=zr0,
+        beta=jnp.float32(0.0),   # first iteration: p ← z + 0·p = z₀ = r₀
+        diff=jnp.float32(jnp.inf),
+    )
+    s = lax.while_loop(cond, body, init)
+    w_own = s.w[lo:hi, 1 : spec.n_blk + 1] * sc_int
+    return w_own, s.k, s.diff, s.zr
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _solve(problem: Problem, mesh: Mesh, spec: ShardSpec, interpret: bool,
+           cs, cw, rhs, sc2, sc_int, colmask) -> PCGResult:
+    px = mesh.shape[X_AXIS]
+    py = mesh.shape[Y_AXIS]
+
+    def shard_fn(cs_b, cw_b, rhs_b, sc2_b, sc_int_b, colmask_b):
+        return _run_shard(
+            problem, spec, px, py, interpret,
+            cs_b[0], cw_b[0], rhs_b[0], sc2_b[0], sc_int_b[0], colmask_b,
+        )
+
+    stacked = P((X_AXIS, Y_AXIS))
+    w_int, k, diff, zr = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(stacked, stacked, stacked, stacked, stacked, P()),
+        out_specs=(P(X_AXIS, Y_AXIS), P(), P(), P()),
+        check_vma=False,
+    )(cs, cw, rhs, sc2, sc_int, colmask)
+    w = jnp.pad(w_int[: problem.M - 1, : problem.N - 1], 1)
+    return PCGResult(w=w, iterations=k, diff=diff, residual_dot=zr)
+
+
+def pallas_cg_solve_sharded(problem: Problem, mesh: Mesh,
+                            bm: int | None = None,
+                            interpret: bool | None = None,
+                            dtype_name: str = "float32",
+                            rhs_gate=None) -> PCGResult:
+    """Distributed solve on the fused Pallas path (fp32, scaled system).
+
+    The stage4-equivalent configuration: per-shard fused kernels + mesh
+    collectives. ``interpret`` defaults to True off-TPU so the kernels run
+    (and are tested) on the virtual CPU mesh. ``rhs_gate`` as in
+    ``pallas_cg_solve``.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    px = mesh.shape[X_AXIS]
+    py = mesh.shape[Y_AXIS]
+    spec = shard_spec(problem, px, py, bm)
+    cs, cw, rhs, sc2, sc_int, colmask = _shard_canvases(
+        problem, px, py, spec, dtype_name
+    )
+    if rhs_gate is not None:
+        rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
+    return _solve(problem, mesh, spec, interpret,
+                  cs, cw, rhs, sc2, sc_int, colmask)
