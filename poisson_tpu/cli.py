@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stage0's unweighted convergence norm")
     p.add_argument("--repeat", type=int, default=1,
                    help="timed solve repetitions; report the best")
+    p.add_argument("--checkpoint", metavar="PATH", default=None,
+                   help="persist solver state to PATH every --chunk "
+                        "iterations and resume from it (xla backend)")
+    p.add_argument("--chunk", type=int, default=200,
+                   help="iterations between checkpoints (default 200)")
     p.add_argument("--json", action="store_true", help="one JSON line instead of a table")
     p.add_argument("--categories", action="store_true",
                    help="reconstructed per-op timing decomposition (stage4's table)")
@@ -124,6 +129,8 @@ def _pick_backend(args) -> str:
         return args.backend
     devices = jax.devices()
     tpu = devices[0].platform == "tpu"
+    if args.checkpoint:
+        return "xla"  # the checkpointed solver drives the XLA path
     if len(devices) > 1 or args.mesh is not None:
         # pallas-sharded builds its canvases on the host; an explicit
         # --setup device request keeps the XLA sharded path.
@@ -161,6 +168,11 @@ def _run_jax(args, problem: Problem, backend: str):
                     "--backend pallas-sharded is the fp32 fused path; use "
                     "--backend sharded for float64"
                 )
+            if args.setup == "device":
+                raise SystemExit(
+                    "--backend pallas-sharded builds its canvases on the "
+                    "host; use --backend sharded for --setup device"
+                )
             run = lambda: pallas_cg_solve_sharded(problem, mesh)
         else:
             run = lambda: pcg_solve_sharded(
@@ -176,6 +188,13 @@ def _run_jax(args, problem: Problem, backend: str):
         from poisson_tpu.ops.pallas_cg import pallas_cg_solve
 
         run = lambda: pallas_cg_solve(problem)
+        n_dev = 1
+    elif args.checkpoint:
+        from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
+
+        run = lambda: pcg_solve_checkpointed(
+            problem, args.checkpoint, chunk=args.chunk, dtype=args.dtype
+        )
         n_dev = 1
     else:
         from poisson_tpu.solvers.pcg import pcg_solve
@@ -266,6 +285,8 @@ def main(argv=None) -> int:
     problem = _problem(args)
     if args.categories and args.json:
         raise SystemExit("--categories produces a table; drop --json")
+    if args.checkpoint and args.backend not in ("auto", "xla"):
+        raise SystemExit("--checkpoint is supported on the xla backend")
 
     if args.dtype == "float64" and args.backend != "native":
         import jax

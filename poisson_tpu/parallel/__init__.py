@@ -1,5 +1,4 @@
 from poisson_tpu.parallel.mesh import choose_process_grid, make_solver_mesh
-from poisson_tpu.parallel.pallas_sharded import pallas_cg_solve_sharded
 from poisson_tpu.parallel.pcg_sharded import pcg_solve_sharded
 
 __all__ = [
@@ -8,3 +7,13 @@ __all__ = [
     "pallas_cg_solve_sharded",
     "pcg_solve_sharded",
 ]
+
+
+def __getattr__(name):
+    # Lazy: keep jax.experimental.pallas out of plain-XLA consumers'
+    # import path (matching the deferred imports in bench/cli/sweep).
+    if name == "pallas_cg_solve_sharded":
+        from poisson_tpu.parallel.pallas_sharded import pallas_cg_solve_sharded
+
+        return pallas_cg_solve_sharded
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,85 @@
+"""Multi-host launch: the framework's analog of the reference's MPI world.
+
+The reference scales across nodes with `mpirun` + Spectrum MPI over
+InfiniBand (``stage4-mpi+cuda/Makefile:2``, SURVEY §2.4). On TPU pods the
+same role is played by ``jax.distributed``: every host runs this same
+program, JAX forms the global device view, and the existing solvers work
+unchanged — ``make_solver_mesh()`` simply sees all chips in the pod, the
+``ppermute`` halo shifts ride ICI within a slice and DCN across slices,
+and ``psum`` spans the global mesh. Nothing else in the framework is
+multi-host-aware, by design: SPMD means the per-host program is identical.
+
+Usage (per host, e.g. under a pod scheduler):
+
+    from poisson_tpu.parallel.multihost import initialize_multihost
+    initialize_multihost()            # env-driven (TPU pods: automatic)
+    mesh = make_solver_mesh()         # global mesh over every chip
+    result = pallas_cg_solve_sharded(problem, mesh)
+
+or explicitly for CPU/GPU clusters:
+
+    initialize_multihost(coordinator="10.0.0.1:1234",
+                         num_processes=4, process_id=rank)
+
+Single-host validation of the multi-process code path: JAX supports
+multiple CPU processes on one machine (each process owning a subset of
+virtual devices), but the halo/psum logic is identical to the virtual
+8-device mesh the test suite already exercises — multi-host adds only the
+transport, which is XLA's, not ours.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def initialize_multihost(coordinator: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> int:
+    """Join (or form) the distributed runtime; returns this process's index.
+
+    With no arguments, relies on the environment (TPU pods populate
+    everything automatically; see ``jax.distributed.initialize``). Must be
+    the FIRST JAX call in the process — initializing the XLA backend first
+    (even implicitly, e.g. via ``jax.devices()``) makes multi-host init
+    impossible, and that mistake is surfaced as an error here rather than
+    silently degrading to per-host solo solves. Calling again after a
+    successful init, or in a single-process environment with no cluster
+    configuration, is a harmless no-op.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" in msg:
+            pass  # second call: keep the existing runtime
+        elif "backend" in msg or "before" in msg:
+            raise RuntimeError(
+                "initialize_multihost() must be the first JAX call in the "
+                "process — the XLA backend is already initialized, so the "
+                "distributed runtime can no longer form. Move the call "
+                "ahead of any jax.devices()/jnp use."
+            ) from e
+        elif coordinator is None and (
+            "coordinator" in msg or "environment" in msg or "detect" in msg
+        ):
+            pass  # no cluster configured: single-process run
+        else:
+            raise
+    except ValueError:
+        if coordinator is not None:
+            raise  # explicit-cluster arguments were wrong: surface it
+        # No cluster in the environment: single-process run.
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """True on the process that should print/persist results (the
+    reference's rank-0 idiom, ``stage2:…cpp:493-498``)."""
+    return jax.process_index() == 0

@@ -1,0 +1,135 @@
+"""Checkpoint/resume for long solves.
+
+The reference has no checkpointing (SURVEY §5: a solve runs to convergence
+in one shot and the solution never touches disk). At pod scale a preempted
+job restarts from iteration zero, so this framework adds the missing
+subsystem: the solve runs as fixed-size chunks of the shared PCG body, and
+after each chunk the five-array CG state (w, r, z, p, ζ) plus iteration
+counter is persisted. A restart with the same problem resumes from the
+last chunk boundary and converges to the same answer — CG's iterate
+sequence is a pure function of its state, so chunked and one-shot solves
+are identical to round-off.
+
+Format: a single ``.npz`` (numpy, host-side) with a problem fingerprint;
+a mismatched fingerprint refuses to resume rather than silently solving a
+different problem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers.pcg import (
+    PCGResult,
+    PCGState,
+    host_setup,
+    init_state,
+    make_pcg_body,
+    resolve_dtype,
+    resolve_scaled,
+    scaled_single_device_ops,
+    single_device_ops,
+)
+
+_STATE_KEYS = ("k", "done", "w", "r", "z", "p", "zr", "diff")
+
+
+def _fingerprint(problem: Problem, dtype_name: str, scaled: bool) -> str:
+    return repr((dataclasses.astuple(problem), dtype_name, scaled))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _run_chunk(problem: Problem, scaled: bool, chunk: int,
+               a, b, aux, state: PCGState) -> PCGState:
+    """Advance the solve by at most ``chunk`` iterations (device-resident)."""
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    body = make_pcg_body(
+        ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+    stop_at = jnp.minimum(state.k + chunk, problem.iteration_cap)
+
+    def cond(s: PCGState):
+        return (~s.done) & (s.k < stop_at)
+
+    return lax.while_loop(cond, body, state)
+
+
+def save_state(path: str, state: PCGState, fingerprint: str) -> None:
+    arrays = {key: np.asarray(val) for key, val in zip(_STATE_KEYS, state)}
+    # np.savez appends '.npz' to names without it — keep the temp name
+    # suffixed so the atomic-replace source path is what savez wrote.
+    tmp = f"{path}.{os.getpid()}.tmp.npz"
+    np.savez(tmp, fingerprint=np.asarray(fingerprint), **arrays)
+    os.replace(tmp, path)
+
+
+def load_state(path: str, fingerprint: str) -> Optional[PCGState]:
+    """Returns the saved state, or None if absent; raises on a
+    fingerprint mismatch (wrong problem/precision for this checkpoint)."""
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as data:
+        saved = str(data["fingerprint"])
+        if saved != fingerprint:
+            raise ValueError(
+                f"checkpoint {path} was written for a different problem "
+                f"configuration:\n  saved:     {saved}\n  requested: "
+                f"{fingerprint}"
+            )
+        vals = {key: data[key] for key in _STATE_KEYS}
+    as_dev = lambda x: jnp.asarray(x)
+    return PCGState(**{key: as_dev(val) for key, val in vals.items()})
+
+
+def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
+                           chunk: int = 200, dtype=None, scaled=None,
+                           keep_checkpoint: bool = False) -> PCGResult:
+    """Solve with periodic state persistence and automatic resume.
+
+    Every ``chunk`` iterations the CG state is written to
+    ``checkpoint_path``; if that file already exists (same problem
+    fingerprint) the solve resumes from it instead of starting over. On
+    convergence the checkpoint is removed unless ``keep_checkpoint``.
+    """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+    fp = _fingerprint(problem, dtype_name, use_scaled)
+
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if use_scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    state = load_state(checkpoint_path, fp)
+    if state is None:
+        state = init_state(ops, rhs)
+
+    while (not bool(state.done)) and int(state.k) < problem.iteration_cap:
+        state = _run_chunk(problem, use_scaled, chunk, a, b, aux, state)
+        jax.block_until_ready(state)
+        save_state(checkpoint_path, state, fp)
+
+    if not keep_checkpoint and os.path.exists(checkpoint_path):
+        os.remove(checkpoint_path)
+
+    w = state.w * aux if use_scaled else state.w
+    return PCGResult(
+        w=w, iterations=state.k, diff=state.diff, residual_dot=state.zr
+    )

@@ -1,0 +1,82 @@
+"""Checkpoint/resume: chunked solves equal one-shot solves, and a restart
+resumes from the last chunk boundary instead of iteration zero."""
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers.checkpoint import (
+    load_state,
+    pcg_solve_checkpointed,
+    save_state,
+)
+from poisson_tpu.solvers.pcg import pcg_solve
+
+
+def test_chunked_equals_oneshot(tmp_path):
+    p = Problem(M=40, N=40)
+    ref = pcg_solve(p)
+    got = pcg_solve_checkpointed(p, str(tmp_path / "ck.npz"), chunk=7)
+    assert int(got.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=0, atol=1e-12
+    )
+    # Converged run cleans its checkpoint up.
+    assert not (tmp_path / "ck.npz").exists()
+
+
+def test_resume_from_partial_checkpoint(tmp_path):
+    """Simulate preemption: stop after a few chunks (iteration cap), then
+    resume with the full budget — total work and answer match one-shot."""
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+
+    capped = p.with_(max_iter=20)
+    partial = pcg_solve_checkpointed(capped, path, chunk=10,
+                                     keep_checkpoint=True)
+    assert int(partial.iterations) == 20
+    assert (tmp_path / "ck.npz").exists()
+
+    # A fingerprint must bind the checkpoint to its problem: the capped
+    # run's fingerprint differs (max_iter), so resuming the uncapped
+    # problem with it must refuse...
+    with pytest.raises(ValueError, match="different problem"):
+        pcg_solve_checkpointed(p, path, chunk=10)
+
+    # ...while resuming the same (capped→extended by new object with same
+    # tuple) configuration continues from iteration 20.
+    extended = capped.with_(max_iter=20)  # identical fingerprint
+    again = pcg_solve_checkpointed(extended, path, chunk=10,
+                                   keep_checkpoint=True)
+    assert int(again.iterations) == 20  # already at cap: no extra work
+
+    ref = pcg_solve(p)
+    full = pcg_solve_checkpointed(p, str(tmp_path / "ck2.npz"), chunk=13)
+    assert int(full.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(full.w), np.asarray(ref.w), rtol=0, atol=1e-12
+    )
+
+
+def test_state_roundtrip(tmp_path):
+    p = Problem(M=20, N=20)
+    ref = pcg_solve(p)
+    path = str(tmp_path / "s.npz")
+
+    partial = pcg_solve_checkpointed(p.with_(max_iter=5), path, chunk=5,
+                                     keep_checkpoint=True)
+    state = load_state(path, _fp(p.with_(max_iter=5)))
+    assert int(state.k) == 5
+    save_state(path, state, _fp(p.with_(max_iter=5)))
+    state2 = load_state(path, _fp(p.with_(max_iter=5)))
+    np.testing.assert_array_equal(np.asarray(state.w), np.asarray(state2.w))
+    assert int(partial.iterations) == 5
+    assert int(ref.iterations) > 5
+
+
+def _fp(problem):
+    from poisson_tpu.solvers.checkpoint import _fingerprint
+    from poisson_tpu.solvers.pcg import resolve_dtype, resolve_scaled
+
+    d = resolve_dtype(None)
+    return _fingerprint(problem, d, resolve_scaled(None, d))
