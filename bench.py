@@ -58,8 +58,11 @@ def main() -> int:
     # `python bench.py M N` benches another grid with the same methodology.
     if len(sys.argv) == 3:
         problem = Problem(M=int(sys.argv[1]), N=int(sys.argv[2]))
-    else:
+    elif len(sys.argv) == 1:
         problem = Problem(M=800, N=1200)
+    else:
+        print("usage: python bench.py [M N]", file=sys.stderr)
+        return 2
     dtype = jnp.float32
     devices = jax.devices()
     platform = devices[0].platform

@@ -79,6 +79,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "iterations and resume from it (xla backend)")
     p.add_argument("--chunk", type=int, default=200,
                    help="iterations between checkpoints (default 200)")
+    p.add_argument("--save-solution", metavar="PATH", default=None,
+                   help="write the solution grid to PATH (.npy) — the "
+                        "reference never persisted its solution")
     p.add_argument("--json", action="store_true", help="one JSON line instead of a table")
     p.add_argument("--categories", action="store_true",
                    help="reconstructed per-op timing decomposition (stage4's table)")
@@ -119,7 +122,7 @@ def _run_native(args, problem: Problem):
         problem, result, best, compile_seconds=0.0, dtype="float64",
         devices=0, l2_error=_l2_error_np(problem, result.w),
     )
-    return report, timer
+    return report, timer, result.w
 
 
 def _pick_backend(args) -> str:
@@ -230,7 +233,7 @@ def _run_jax(args, problem: Problem, backend: str):
         dtype=dtype_name, devices=n_dev, mesh=mesh_shape,
         l2_error=_l2_error_np(problem, np.asarray(result.w)),
     )
-    return report, timer
+    return report, timer, np.asarray(result.w)
 
 
 def _categories_table(problem: Problem, dtype, iters: int) -> list[str]:
@@ -287,6 +290,8 @@ def main(argv=None) -> int:
         raise SystemExit("--categories produces a table; drop --json")
     if args.checkpoint and args.backend not in ("auto", "xla"):
         raise SystemExit("--checkpoint is supported on the xla backend")
+    if args.checkpoint and args.mesh is not None:
+        raise SystemExit("--checkpoint runs single-device; drop --mesh")
 
     if args.dtype == "float64" and args.backend != "native":
         import jax
@@ -300,11 +305,13 @@ def main(argv=None) -> int:
         if args.categories:
             raise SystemExit("--categories times the JAX ops; "
                              "not available with --backend native")
-        report, timer = _run_native(args, problem)
+        report, timer, w = _run_native(args, problem)
     else:
         backend = _pick_backend(args)
-        report, timer = _run_jax(args, problem, backend)
+        report, timer, w = _run_jax(args, problem, backend)
 
+    if args.save_solution:
+        np.save(args.save_solution, np.asarray(w, np.float64))
     if args.json:
         print(report.json_line())
         return 0

@@ -49,6 +49,9 @@ def initialize_multihost(coordinator: Optional[str] = None,
     successful init, or in a single-process environment with no cluster
     configuration, is a harmless no-op.
     """
+    global _initialized
+    if _initialized:
+        return jax.process_index()  # documented no-op on a second call
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator,
@@ -57,8 +60,8 @@ def initialize_multihost(coordinator: Optional[str] = None,
         )
     except RuntimeError as e:
         msg = str(e).lower()
-        if "already" in msg:
-            pass  # second call: keep the existing runtime
+        if "already" in msg or "once" in msg:
+            pass  # runtime formed elsewhere: keep it
         elif "backend" in msg or "before" in msg:
             raise RuntimeError(
                 "initialize_multihost() must be the first JAX call in the "
@@ -76,7 +79,11 @@ def initialize_multihost(coordinator: Optional[str] = None,
         if coordinator is not None:
             raise  # explicit-cluster arguments were wrong: surface it
         # No cluster in the environment: single-process run.
+    _initialized = True
     return jax.process_index()
+
+
+_initialized = False
 
 
 def is_primary() -> bool:

@@ -44,7 +44,15 @@ _STATE_KEYS = ("k", "done", "w", "r", "z", "p", "zr", "diff")
 
 
 def _fingerprint(problem: Problem, dtype_name: str, scaled: bool) -> str:
-    return repr((dataclasses.astuple(problem), dtype_name, scaled))
+    # Bind problem identity, not the stopping budget: max_iter is excluded
+    # so a run capped by --max-iter (or preempted) can resume with a larger
+    # budget — the natural recovery workflow.
+    fields = {
+        f.name: getattr(problem, f.name)
+        for f in dataclasses.fields(problem)
+        if f.name != "max_iter"
+    }
+    return repr((sorted(fields.items()), dtype_name, scaled))
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
@@ -126,7 +134,10 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
         jax.block_until_ready(state)
         save_state(checkpoint_path, state, fp)
 
-    if not keep_checkpoint and os.path.exists(checkpoint_path):
+    # Clean up only a *converged* run's checkpoint; hitting the iteration
+    # cap unconverged keeps it so a rerun with a larger budget resumes.
+    converged = bool(state.done)
+    if converged and not keep_checkpoint and os.path.exists(checkpoint_path):
         os.remove(checkpoint_path)
 
     w = state.w * aux if use_scaled else state.w

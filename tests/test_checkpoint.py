@@ -26,36 +26,35 @@ def test_chunked_equals_oneshot(tmp_path):
 
 
 def test_resume_from_partial_checkpoint(tmp_path):
-    """Simulate preemption: stop after a few chunks (iteration cap), then
-    resume with the full budget — total work and answer match one-shot."""
+    """Simulate preemption: stop at an iteration cap, then resume with the
+    full budget — total work and answer match the one-shot solve."""
     p = Problem(M=40, N=40)
     path = str(tmp_path / "ck.npz")
 
     capped = p.with_(max_iter=20)
-    partial = pcg_solve_checkpointed(capped, path, chunk=10,
-                                     keep_checkpoint=True)
+    partial = pcg_solve_checkpointed(capped, path, chunk=10)
     assert int(partial.iterations) == 20
+    # Unconverged cap-hit keeps the checkpoint even without keep_checkpoint.
     assert (tmp_path / "ck.npz").exists()
 
-    # A fingerprint must bind the checkpoint to its problem: the capped
-    # run's fingerprint differs (max_iter), so resuming the uncapped
-    # problem with it must refuse...
-    with pytest.raises(ValueError, match="different problem"):
-        pcg_solve_checkpointed(p, path, chunk=10)
-
-    # ...while resuming the same (capped→extended by new object with same
-    # tuple) configuration continues from iteration 20.
-    extended = capped.with_(max_iter=20)  # identical fingerprint
-    again = pcg_solve_checkpointed(extended, path, chunk=10,
-                                   keep_checkpoint=True)
-    assert int(again.iterations) == 20  # already at cap: no extra work
-
+    # max_iter is excluded from the fingerprint: the uncapped rerun resumes
+    # from iteration 20 and converges identically to a one-shot solve.
     ref = pcg_solve(p)
-    full = pcg_solve_checkpointed(p, str(tmp_path / "ck2.npz"), chunk=13)
-    assert int(full.iterations) == int(ref.iterations)
+    resumed = pcg_solve_checkpointed(p, path, chunk=10)
+    assert int(resumed.iterations) == int(ref.iterations)
     np.testing.assert_allclose(
-        np.asarray(full.w), np.asarray(ref.w), rtol=0, atol=1e-12
+        np.asarray(resumed.w), np.asarray(ref.w), rtol=0, atol=1e-12
     )
+    assert not (tmp_path / "ck.npz").exists()  # converged → cleaned up
+
+
+def test_fingerprint_refuses_different_problem(tmp_path):
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    pcg_solve_checkpointed(p.with_(max_iter=20), path, chunk=10)
+    # delta is part of problem identity (unlike max_iter).
+    with pytest.raises(ValueError, match="different problem"):
+        pcg_solve_checkpointed(p.with_(delta=1e-4), path, chunk=10)
 
 
 def test_state_roundtrip(tmp_path):
