@@ -1,0 +1,79 @@
+"""Render the convergence/accuracy curve (the reference reports' figure).
+
+    python benchmarks/sweep.py --curve 400x600:600 --curve-out curve.csv
+    python benchmarks/plot_curve.py curve.csv curve.png
+
+One log-scale axis carries both norms (same unit family — error magnitudes);
+series colors are the validated reference categorical palette (slots 1-2),
+2px lines, recessive grid, direct end labels plus a legend.
+"""
+
+from __future__ import annotations
+
+import csv
+import sys
+
+SERIES_1 = "#2a78d6"   # blue: ||w(k+1) - w(k)||
+SERIES_2 = "#eb6834"   # orange: L2 error vs analytic
+SURFACE = "#fcfcfb"
+TEXT_PRIMARY = "#0b0b0b"
+TEXT_SECONDARY = "#52514e"
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print("usage: python benchmarks/plot_curve.py curve.csv out.png",
+              file=sys.stderr)
+        return 2
+    src, out = argv
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    its, diffs, errs = [], [], []
+    with open(src) as f:
+        for row in csv.DictReader(f):
+            its.append(int(row["iteration"]))
+            diffs.append(float(row["diff_norm"]))
+            errs.append(float(row["l2_error"]))
+
+    fig, ax = plt.subplots(figsize=(7.2, 4.2), dpi=160)
+    fig.patch.set_facecolor(SURFACE)
+    ax.set_facecolor(SURFACE)
+
+    ax.plot(its, diffs, color=SERIES_1, lw=2, label="‖w(k+1) − w(k)‖")
+    ax.plot(its, errs, color=SERIES_2, lw=2, label="L2 error vs analytic")
+    ax.set_yscale("log")
+
+    # Direct labels at the line ends (identity not by color alone).
+    ax.annotate("update norm", (its[-1], diffs[-1]),
+                xytext=(4, 0), textcoords="offset points",
+                color=SERIES_1, fontsize=9, va="center")
+    ax.annotate("L2 error", (its[-1], errs[-1]),
+                xytext=(4, 0), textcoords="offset points",
+                color=SERIES_2, fontsize=9, va="center")
+
+    ax.set_xlabel("PCG iteration", color=TEXT_SECONDARY)
+    ax.set_ylabel("norm (log scale)", color=TEXT_SECONDARY)
+    ax.set_title("Convergence and accuracy vs iteration",
+                 color=TEXT_PRIMARY, fontsize=11, loc="left")
+    ax.grid(True, which="major", color="#e4e3df", lw=0.6)
+    ax.tick_params(colors=TEXT_SECONDARY, labelsize=8)
+    for spine in ("top", "right"):
+        ax.spines[spine].set_visible(False)
+    for spine in ("left", "bottom"):
+        ax.spines[spine].set_color("#d4d3cf")
+    ax.legend(frameon=False, fontsize=9, labelcolor=TEXT_PRIMARY)
+    ax.margins(x=0.12)
+
+    fig.tight_layout()
+    fig.savefig(out, facecolor=SURFACE)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,3 +1,13 @@
+from poisson_tpu.solvers.adjoint import differentiable_solve
+from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
+from poisson_tpu.solvers.history import pcg_solve_history
 from poisson_tpu.solvers.pcg import PCGResult, pcg_solve, pcg_step_fn
 
-__all__ = ["PCGResult", "pcg_solve", "pcg_step_fn"]
+__all__ = [
+    "PCGResult",
+    "differentiable_solve",
+    "pcg_solve",
+    "pcg_solve_checkpointed",
+    "pcg_solve_history",
+    "pcg_step_fn",
+]

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from poisson_tpu.config import Problem
-from poisson_tpu.ops.stencil import apply_A
+from poisson_tpu.ops.stencil import apply_A, interior, pad_interior
 from poisson_tpu.solvers.pcg import (
     _solve,
     host_setup,
@@ -54,7 +54,7 @@ def _make_differentiable(problem: Problem, dtype_name: str, scaled: bool):
         return _solve(problem, scaled, a, b, r, aux).w
 
     def solve(rhs_grid):
-        rhs_proj = jnp.pad(rhs_grid[1:-1, 1:-1], 1)
+        rhs_proj = pad_interior(interior(rhs_grid))
         # symmetric=True makes the transpose solve the same solve, giving
         # correct jvp, vjp, and linear_transpose without a custom rule.
         return lax.custom_linear_solve(
