@@ -39,6 +39,9 @@ def main(argv=None) -> int:
             its.append(int(row["iteration"]))
             diffs.append(float(row["diff_norm"]))
             errs.append(float(row["l2_error"]))
+    if not its:
+        print(f"{src} has no data rows", file=sys.stderr)
+        return 2
 
     fig, ax = plt.subplots(figsize=(7.2, 4.2), dpi=160)
     fig.patch.set_facecolor(SURFACE)

@@ -7,6 +7,13 @@ why this lives at the top of conftest.
 """
 
 import os
+import sys
+
+# The package is run from a checkout, not installed: make the suite
+# cwd-independent by ensuring the repo root is importable.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")

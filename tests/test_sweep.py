@@ -1,0 +1,33 @@
+"""Benchmark sweep harness: table generation and curve output."""
+
+import csv
+import pathlib
+import sys
+
+_BENCH_DIR = str(pathlib.Path(__file__).resolve().parents[1] / "benchmarks")
+
+
+def test_sweep_tiny_grid(tmp_path, capsys):
+    sys.path.insert(0, _BENCH_DIR)
+    try:
+        import sweep
+    finally:
+        sys.path.remove(_BENCH_DIR)
+
+    out = tmp_path / "table.md"
+    curve = tmp_path / "curve.csv"
+    rc = sweep.main([
+        "--grids", "20x20", "--backends", "xla,native", "--threads", "1",
+        "--repeat", "1", "--out", str(out),
+        "--curve", "20x20:40", "--curve-out", str(curve),
+    ])
+    assert rc == 0
+
+    table = out.read_text()
+    assert "| xla |" in table and "| native |" in table
+    assert "20x20" in table
+
+    with open(curve) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 40
+    assert float(rows[0]["diff_norm"]) > float(rows[-1]["diff_norm"])
