@@ -69,6 +69,18 @@ def test_native_golden_400x600():
     assert abs(r.iterations - 546) <= 1
 
 
+@pytest.mark.parametrize("M,N", [(2, 2), (2, 10), (10, 2), (3, 200)])
+def test_edge_grids_agree_with_jax(M, N):
+    """Degenerate-direction and iteration-cap semantics on minimal grids:
+    tiny interiors exhaust the Krylov space (exact solve) or hit the
+    (M-1)(N-1) cap — both backends must stop identically."""
+    p = Problem(M=M, N=N)
+    rn = native_solve(p, num_threads=1)
+    rj = pcg_solve(p)
+    assert rn.iterations == int(rj.iterations)
+    np.testing.assert_allclose(rn.w, np.asarray(rj.w), rtol=0, atol=1e-10)
+
+
 @pytest.mark.xslow
 @pytest.mark.parametrize(
     "M,N,expected", [(1600, 2400, 1858), (2400, 3200, 2449)]
