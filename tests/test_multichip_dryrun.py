@@ -1,0 +1,39 @@
+"""Multi-chip dry-run contract at device counts beyond the suite's mesh.
+
+The driver validates multi-chip sharding by running
+``__graft_entry__.dryrun_multichip(n)`` under
+``--xla_force_host_platform_device_count=n``. The suite's own process is
+pinned to 8 virtual devices (conftest), so higher counts run in a
+subprocess with their own XLA flags — the closest single-host stand-in for
+a larger pod slice.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [16, 32])
+def test_dryrun_multichip_scales(n):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+    env["PYTHONPATH"] = str(_ROOT)
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import __graft_entry__ as g;"
+        f"g.dryrun_multichip({n});"
+        "print('OK', len(jax.devices()))"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=_ROOT, env=env,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"OK {n}" in proc.stdout
