@@ -48,7 +48,7 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    from poisson_tpu.analysis import l2_error_vs_analytic
+    from poisson_tpu.analysis import l2_error_host
     from poisson_tpu.config import Problem
     from poisson_tpu.parallel import make_solver_mesh, pcg_solve_sharded
     from poisson_tpu.solvers.pcg import pcg_solve
@@ -141,7 +141,7 @@ def main() -> int:
 
     iters = int(result.iterations)
     value = mlups(problem, iters, best)
-    err = float(l2_error_vs_analytic(problem, result.w))
+    err = l2_error_host(problem, result.w)
 
     print(
         json.dumps(

@@ -125,9 +125,8 @@ def main(argv=None) -> int:
     args = _parse_args(argv)
 
     import jax
-    import numpy as np
 
-    from poisson_tpu.analysis import l2_error_vs_analytic
+    from poisson_tpu.analysis import l2_error_host as l2
     from poisson_tpu.config import Problem
     from poisson_tpu.utils.timing import fence
 
@@ -147,11 +146,6 @@ def main(argv=None) -> int:
 
     grids = [_parse_pair(g) for g in args.grids.split(",")]
     threads = [int(t) for t in args.threads.split(",")]
-
-    def l2(problem, w):
-        return float(
-            l2_error_vs_analytic(problem, np.asarray(w, np.float64), xp=np)
-        )
 
     rows = []
     for grid in grids:

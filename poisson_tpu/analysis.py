@@ -27,3 +27,13 @@ def l2_error_vs_analytic(problem: Problem, w, xp=jnp):
     mask = is_in_domain(x, y)
     err2 = xp.where(mask, (w - u) ** 2, 0.0)
     return xp.sqrt(xp.sum(err2) * (problem.h1 * problem.h2))
+
+
+def l2_error_host(problem: Problem, w) -> float:
+    """Host-side (numpy fp64) variant: no device work, plain float out —
+    the form every reporting path (CLI, sweep, bench detail) consumes."""
+    import numpy as np
+
+    return float(
+        l2_error_vs_analytic(problem, np.asarray(w, np.float64), xp=np)
+    )

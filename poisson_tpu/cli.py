@@ -97,16 +97,8 @@ def _problem(args) -> Problem:
     )
 
 
-def _l2_error_np(problem: Problem, w: np.ndarray) -> float:
-    """Host-side (numpy) L2(D) error — no device round-trip."""
-    from poisson_tpu.analysis import l2_error_vs_analytic
-
-    return float(
-        l2_error_vs_analytic(problem, np.asarray(w, np.float64), xp=np)
-    )
-
-
 def _run_native(args, problem: Problem):
+    from poisson_tpu.analysis import l2_error_host
     from poisson_tpu.native import build, native_solve
 
     build()  # one-time g++ compile stays out of the timed phases
@@ -120,7 +112,7 @@ def _run_native(args, problem: Problem):
         best = min(best, time.perf_counter() - t0)
     report = solve_report(
         problem, result, best, compile_seconds=0.0, dtype="float64",
-        devices=0, l2_error=_l2_error_np(problem, result.w),
+        devices=0, l2_error=l2_error_host(problem, result.w),
     )
     return report, timer, result.w
 
@@ -147,6 +139,8 @@ def _pick_backend(args) -> str:
 
 def _run_jax(args, problem: Problem, backend: str):
     import jax
+
+    from poisson_tpu.analysis import l2_error_host
 
     timer = PhaseTimer()
     mesh_shape: Optional[tuple[int, int]] = None
@@ -231,7 +225,7 @@ def _run_jax(args, problem: Problem, backend: str):
         problem, result, best,
         compile_seconds=timer.times["compile_and_first_solve"] - best,
         dtype=dtype_name, devices=n_dev, mesh=mesh_shape,
-        l2_error=_l2_error_np(problem, np.asarray(result.w)),
+        l2_error=l2_error_host(problem, result.w),
     )
     return report, timer, np.asarray(result.w)
 

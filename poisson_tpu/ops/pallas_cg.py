@@ -79,14 +79,21 @@ HALO = SUBLANE  # strip halo rows: 1 would do, 8 keeps blocks sublane-aligned
 VMEM_BUDGET = 12 * 2 ** 20  # leave headroom under the ~16 MB/core VMEM
 
 
-def pick_bm(problem: Problem) -> int:
-    """Strip height: fills the VMEM budget at ~12 strip-buffers in flight
-    (kernel A: 4 in + 2 out, double-buffered), capped at 128 rows, floored
-    at one sublane granule."""
-    c = canvas_cols(problem)
-    rows = VMEM_BUDGET // (12 * c * 4)
-    rows = min(rows, 128, max(problem.M - 1, SUBLANE))
+def strip_height(cols: int, owned_rows: int) -> int:
+    """Strip height for a canvas of ``cols`` columns covering ``owned_rows``
+    interior rows: fills the VMEM budget at ~12 strip-buffers in flight
+    (kernel A: 4 in + 2 out, double-buffered), capped at 128 rows and at
+    the owned band, floored at one sublane granule. Shared by the
+    single-device and sharded canvas geometries."""
+    rows = VMEM_BUDGET // (12 * cols * 4)
+    owned_cap = max(SUBLANE, -(-owned_rows // SUBLANE) * SUBLANE)
+    rows = min(rows, 128, owned_cap)
     return max(SUBLANE, (rows // SUBLANE) * SUBLANE)
+
+
+def pick_bm(problem: Problem) -> int:
+    """Single-device strip height (see :func:`strip_height`)."""
+    return strip_height(canvas_cols(problem), problem.M - 1)
 
 
 def canvas_cols(problem: Problem) -> int:

@@ -55,11 +55,11 @@ from poisson_tpu.ops.pallas_cg import (
     HALO,
     LANE,
     SUBLANE,
-    VMEM_BUDGET,
     Canvas,
     direction_and_stencil,
     fused_update,
     scaled_stencil_fields,
+    strip_height,
 )
 from poisson_tpu.parallel.mesh import X_AXIS, Y_AXIS
 from poisson_tpu.solvers.pcg import PCGResult, _DENOM_TOL
@@ -80,11 +80,7 @@ def shard_spec(problem: Problem, px: int, py: int,
     n_blk = -(-(problem.N - 1) // py)
     cols = ((n_blk + 2 + LANE - 1) // LANE) * LANE
     if bm is None:
-        rows_budget = VMEM_BUDGET // (12 * cols * 4)
-        owned = -(-(problem.M - 1) // px)
-        owned_cap = -(-owned // SUBLANE) * SUBLANE  # don't sweep past owned rows
-        bm = max(SUBLANE,
-                 (min(rows_budget, 128, owned_cap) // SUBLANE) * SUBLANE)
+        bm = strip_height(cols, -(-(problem.M - 1) // px))
     if bm <= 0 or bm % SUBLANE != 0:
         raise ValueError(f"bm must be a positive multiple of {SUBLANE}, got {bm}")
     # Owned rows rounded up to the strip height: strips tile the owned band
