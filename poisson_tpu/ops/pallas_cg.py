@@ -22,13 +22,18 @@ The preconditioner apply disappears entirely: the solver runs on the
 symmetrically-scaled system Ã = D^{-1/2}AD^{-1/2} (see
 ``solvers.pcg.scaled_single_device_ops``) whose diagonal is exactly 1, so
 z = r and the reference's ``apply_Dinv_kernel`` (20% of stage4 runtime,
-BASELINE.md Table 2) costs nothing. The scaling itself is folded into two
-precomputed off-diagonal coefficient canvases (``cS``, ``cW`` below), making
-the stencil
-      (Ãp)ᵢⱼ = pᵢⱼ − cSᵢ₊₁ⱼ·pᵢ₊₁ⱼ − cSᵢⱼ·pᵢ₋₁ⱼ − cWᵢⱼ₊₁·pᵢⱼ₊₁ − cWᵢⱼ·pᵢⱼ₋₁
-— 4 multiply-adds per point against the flux form's 11 flops, and only two
-coefficient reads (cN/cE are shifted views of the same canvases, exploiting
-the symmetry cNᵢⱼ = cSᵢ₊₁ⱼ the reference never used).
+BASELINE.md Table 2) costs nothing. The scaling is folded into two
+precomputed off-diagonal coefficient canvases (``cS``, ``cW``; cN/cE are
+shifted views of the same canvases, exploiting the symmetry cNᵢⱼ = cSᵢ₊₁ⱼ
+the reference never used) plus a diagonal-residual canvas γ, and the
+stencil is evaluated in **difference form**
+      (Ãp)ᵢⱼ = Σ_k c̃_k·(pᵢⱼ − p_k) + γᵢⱼ·pᵢⱼ ,
+which pairs adjacent grid values in every product — the fp32 rounding
+stays at the scale of the (small) differences rather than of |p|. This is
+what makes fp32 reproduce the fp64 golden iteration counts *exactly* at
+every published grid (989/1858/2449) and reach the discretisation-floor
+L2 error; the canonical ``p − Σ c̃p_k`` form drifted 0.1–0.3% in count and
+lost 5× in accuracy at 2400×3200 (see :func:`diagonal_residual_canvas`).
 
 Canvas layout
 -------------
@@ -154,9 +159,10 @@ def build_canvases(problem: Problem, bm: int | None = None,
     (or the guard/pad regions) gets coefficient 0 automatically, which is
     what lets the kernels run maskless.
 
-    Returns (cv, cS, cW, rhs, sc2, sc_int): canvases as (R, C) device
+    Returns (cv, cS, cW, g, rhs, sc2, sc_int): canvases as (R, C) device
     arrays, plus the interior scaling slice (device array) for solution
-    extraction.
+    extraction. ``g`` is the diagonal residual (see
+    :func:`diagonal_residual_canvas`).
     """
     cv = canvas_spec(problem, bm)
     dtype = jnp.dtype(dtype_name)
@@ -176,16 +182,40 @@ def build_canvases(problem: Problem, bm: int | None = None,
     cw_canvas = to_canvas(gcw[1:, 1:], col0=1)                    # rows 1..M
     rhs_canvas = to_canvas(rhs64[1:M, :])                         # b̃, rows 1..M-1
     sc2_canvas = to_canvas(sc2_64[1:M, :])
+    g_canvas = diagonal_residual_canvas(cs_canvas, cw_canvas)
 
     as_dev = lambda x: jnp.asarray(x, dtype)
     return (
         cv,
         as_dev(cs_canvas),
         as_dev(cw_canvas),
+        as_dev(g_canvas),
         as_dev(rhs_canvas),
         as_dev(sc2_canvas),
         as_dev(sc64[1:M, 1:N]),
     )
+
+
+def diagonal_residual_canvas(cs_canvas: np.ndarray,
+                             cw_canvas: np.ndarray) -> np.ndarray:
+    """γ = 1 − (c̃N + c̃S + c̃E + c̃W), computed in fp64 from the coefficient
+    canvases.
+
+    The scaled operator in *difference form* is
+        (Ãp)_c = Σ_k c̃_k·(p_c − p_k) + γ_c·p_c ,
+    exactly equivalent to the canonical ``p_c − Σ c̃_k p_k`` but numerically
+    far better in fp32: each difference term pairs adjacent grid values
+    (benign cancellation), while the canonical form subtracts two O(|p|)
+    quantities to produce the small result — amplifying rounding by the
+    smooth-mode factor |p|/|Ãp|. γ is O(h·∂sc) near the embedded boundary,
+    exactly 0 where the scaling is locally constant, and 1 on padding
+    (where all coefficients vanish and p is identically zero).
+    """
+    cs_next = np.zeros_like(cs_canvas)
+    cs_next[:-1] = cs_canvas[1:]
+    cw_east = np.zeros_like(cw_canvas)
+    cw_east[:, :-1] = cw_canvas[:, 1:]
+    return 1.0 - (cs_canvas + cs_next + cw_canvas + cw_east)
 
 
 def _shift_col_minus(u):
@@ -229,7 +259,7 @@ def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
     h = HALO
     band_lo, band_hi = band
 
-    def kernel(beta_ref, z_ref, p_ref, cs_ref, cw_ref, *rest):
+    def kernel(beta_ref, z_ref, p_ref, cs_ref, cw_ref, g_ref, *rest):
         if masked:
             colmask_ref, pn_ref, ap_ref, denom_ref = rest
         else:
@@ -246,11 +276,14 @@ def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
         cs_c = cs_ref[h:-h, :]                     # south-edge coeff at center
         cs_n = cs_ref[h + 1 : -h + 1, :]           # north edge = cS shifted down
         cw_c = cw_ref[:]                           # block-spec'd: center rows only
-        ap = c - (
-            cs_n * pn[h + 1 : -h + 1, :]
-            + cs_c * pn[h - 1 : -h - 1, :]
-            + _shift_col_plus(cw_c) * _shift_col_plus(c)
-            + cw_c * _shift_col_minus(c)
+        # Difference form: adjacent-value differences keep fp32 cancellation
+        # benign on smooth modes (see diagonal_residual_canvas).
+        ap = (
+            cs_n * (c - pn[h + 1 : -h + 1, :])
+            + cs_c * (c - pn[h - 1 : -h - 1, :])
+            + _shift_col_plus(cw_c) * (c - _shift_col_plus(c))
+            + cw_c * (c - _shift_col_minus(c))
+            + g_ref[:] * c
         )
         pn_ref[:] = c
         ap_ref[:] = ap
@@ -341,7 +374,8 @@ def _colmask_spec(cv: Canvas):
     return pl.BlockSpec((1, cv.cols), lambda i: (0, 0))
 
 
-def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, *, interpret: bool,
+def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
+                          interpret: bool,
                           band: tuple[int, int] | None = None, colmask=None):
     """p_new, Ap, Σ Ap·p_new (unweighted) — one HBM sweep.
 
@@ -356,8 +390,9 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, *, interpret: bool,
         _strip_in_spec(cv),   # p: ditto
         _strip_in_spec(cv),   # cs: needs rows up to center+1
         _block_spec(cv),      # cw: only center rows are read
+        _block_spec(cv),      # g (diagonal residual): center rows
     ]
-    operands = [beta, z, p, cs, cw]
+    operands = [beta, z, p, cs, cw, g]
     if masked:
         in_specs.append(_colmask_spec(cv))
         operands.append(colmask)
@@ -426,7 +461,7 @@ class _FusedState(NamedTuple):
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
-                 cs, cw, rhs, sc2):
+                 cs, cw, g, rhs, sc2):
     h1h2 = jnp.float32(problem.h1 * problem.h2)
     norm_w = h1h2 if problem.weighted_norm else jnp.float32(1.0)
     dtype = rhs.dtype
@@ -437,7 +472,7 @@ def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
     def body(s: _FusedState) -> _FusedState:
         beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
         pn, ap, denom_part = direction_and_stencil(
-            cv, beta, s.r, s.p, cs, cw, interpret=interpret
+            cv, beta, s.r, s.p, cs, cw, g, interpret=interpret
         )
         denom = denom_part[0, 0] * h1h2
         degenerate = jnp.abs(denom) < _DENOM_TOL
@@ -472,6 +507,34 @@ def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
     return lax.while_loop(cond, body, init)
 
 
+def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
+                        interpret: bool | None = None,
+                        dtype_name: str = "float32"):
+    """Fused solve of ``A w = rhs`` for a caller-supplied RHS grid
+    (fp64 host array, full (M+1, N+1) shape) — the hook mixed-precision
+    refinement (``solvers.refine``) drives. Coefficient canvases come from
+    the cache; only the RHS canvas is built per call.
+
+    Returns ``(w64, iterations)`` with w accumulated on the host in fp64.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    cv, cs, cw, g, _, sc2, sc_int = build_canvases(problem, bm, dtype_name)
+    _, _, _, _, sc64 = scaled_stencil_fields(problem)
+    M, N = problem.M, problem.N
+    scaled = np.asarray(rhs_grid64, np.float64) * sc64
+    rhs_canvas = np.zeros((cv.rows, cv.cols), np.float64)
+    rhs_canvas[HALO : HALO + M - 1, : N + 1] = scaled[1:M, :]
+    rhs = jnp.asarray(rhs_canvas, jnp.dtype(dtype_name))
+    s = _fused_solve(problem, cv, interpret, cs, cw, g, rhs, sc2)
+    y = s.w[HALO : HALO + M - 1, 1:N]
+    w64 = np.zeros(problem.grid_shape, np.float64)
+    w64[1:M, 1:N] = np.asarray(y, np.float64) * np.asarray(
+        sc_int, np.float64
+    )
+    return w64, int(s.k)
+
+
 def pallas_cg_solve(problem: Problem, bm: int | None = None,
                     interpret: bool | None = None,
                     dtype_name: str = "float32",
@@ -487,10 +550,10 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    cv, cs, cw, rhs, sc2, sc_int = build_canvases(problem, bm, dtype_name)
+    cv, cs, cw, g, rhs, sc2, sc_int = build_canvases(problem, bm, dtype_name)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
-    s = _fused_solve(problem, cv, interpret, cs, cw, rhs, sc2)
+    s = _fused_solve(problem, cv, interpret, cs, cw, g, rhs, sc2)
     # Canvas → full-grid solution, unscaled: w = sc · y.
     M, N = problem.M, problem.N
     y = s.w[HALO : HALO + M - 1, 1:N]

@@ -58,6 +58,7 @@ from poisson_tpu.ops.pallas_cg import (
     Canvas,
     direction_and_stencil,
     fused_update,
+    diagonal_residual_canvas,
     scaled_stencil_fields,
     strip_height,
 )
@@ -129,10 +130,16 @@ def _shard_canvases(problem: Problem, px: int, py: int, spec: ShardSpec,
         if zero_halo_cols:
             out[:, :, 0] = 0.0
             out[:, :, n_blk + 1] = 0.0
-        return jnp.asarray(out, dtype)
+        return out
 
     cs_st = stacked(gcs, zero_pad_cols=True)
     cw_st = stacked(gcw, zero_pad_cols=True)
+    # Diagonal residual per shard, from its own canvases (fp64) — the
+    # difference-form stencil weight (ops.pallas_cg.diagonal_residual_canvas).
+    g_st = np.stack([
+        diagonal_residual_canvas(cs_st[s], cw_st[s])
+        for s in range(px * py)
+    ])
     # rhs keeps real values in its halo ring: that ring seeds r's (and via
     # p0 = r0, p's) fresh halos at iteration 0.
     rhs_st = stacked(rhs64, zero_pad_cols=True)
@@ -151,7 +158,9 @@ def _shard_canvases(problem: Problem, px: int, py: int, spec: ShardSpec,
 
     colmask = np.zeros((1, cv.cols), np.float64)
     colmask[0, 1 : n_blk + 1] = 1.0
-    return cs_st, cw_st, rhs_st, sc2_st, sc_int, jnp.asarray(colmask, dtype)
+    as_dev = lambda x: jnp.asarray(x, dtype)
+    return (as_dev(cs_st), as_dev(cw_st), as_dev(g_st), as_dev(rhs_st),
+            as_dev(sc2_st), sc_int, as_dev(colmask))
 
 
 class _State(NamedTuple):
@@ -181,7 +190,7 @@ def _exchange_r_halo(r, spec: ShardSpec, px: int, py: int):
 
 
 def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
-               interpret: bool, cs, cw, rhs, sc2, sc_int, colmask):
+               interpret: bool, cs, cw, g, rhs, sc2, sc_int, colmask):
     cv = spec.cv
     dtype = rhs.dtype
     h1h2 = jnp.float32(problem.h1 * problem.h2)
@@ -195,7 +204,7 @@ def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
     def body(s: _State) -> _State:
         beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
         pn, ap, denom_part = direction_and_stencil(
-            cv, beta, s.r, s.p, cs, cw, interpret=interpret,
+            cv, beta, s.r, s.p, cs, cw, g, interpret=interpret,
             band=band, colmask=colmask,
         )
         # Halo rows of the new direction: identical to what the row
@@ -251,24 +260,25 @@ def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def _solve(problem: Problem, mesh: Mesh, spec: ShardSpec, interpret: bool,
-           cs, cw, rhs, sc2, sc_int, colmask) -> PCGResult:
+           cs, cw, g, rhs, sc2, sc_int, colmask) -> PCGResult:
     px = mesh.shape[X_AXIS]
     py = mesh.shape[Y_AXIS]
 
-    def shard_fn(cs_b, cw_b, rhs_b, sc2_b, sc_int_b, colmask_b):
+    def shard_fn(cs_b, cw_b, g_b, rhs_b, sc2_b, sc_int_b, colmask_b):
         return _run_shard(
             problem, spec, px, py, interpret,
-            cs_b[0], cw_b[0], rhs_b[0], sc2_b[0], sc_int_b[0], colmask_b,
+            cs_b[0], cw_b[0], g_b[0], rhs_b[0], sc2_b[0], sc_int_b[0],
+            colmask_b,
         )
 
     stacked = P((X_AXIS, Y_AXIS))
     w_int, k, diff, zr = jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(stacked, stacked, stacked, stacked, stacked, P()),
+        in_specs=(stacked, stacked, stacked, stacked, stacked, stacked, P()),
         out_specs=(P(X_AXIS, Y_AXIS), P(), P(), P()),
         check_vma=False,
-    )(cs, cw, rhs, sc2, sc_int, colmask)
+    )(cs, cw, g, rhs, sc2, sc_int, colmask)
     w = jnp.pad(w_int[: problem.M - 1, : problem.N - 1], 1)
     return PCGResult(w=w, iterations=k, diff=diff, residual_dot=zr)
 
@@ -290,10 +300,10 @@ def pallas_cg_solve_sharded(problem: Problem, mesh: Mesh,
     px = mesh.shape[X_AXIS]
     py = mesh.shape[Y_AXIS]
     spec = shard_spec(problem, px, py, bm)
-    cs, cw, rhs, sc2, sc_int, colmask = _shard_canvases(
+    cs, cw, g, rhs, sc2, sc_int, colmask = _shard_canvases(
         problem, px, py, spec, dtype_name
     )
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     return _solve(problem, mesh, spec, interpret,
-                  cs, cw, rhs, sc2, sc_int, colmask)
+                  cs, cw, g, rhs, sc2, sc_int, colmask)

@@ -37,7 +37,7 @@ def test_full_solve_parity_vs_xla_f32(M, N, bm):
 
 def test_canvases_zero_outside_interior():
     p = Problem(M=40, N=40)
-    cv, cs, cw, rhs, sc2, sc64 = build_canvases(p, 16)
+    cv, cs, cw, g, rhs, sc2, sc64 = build_canvases(p, 16)
     band = slice(HALO, HALO + p.M - 1)
     for name, arr, interior_cols in [
         ("rhs", rhs, slice(1, p.N)),
@@ -70,7 +70,7 @@ def test_kernel_a_matches_scaled_operator():
     """Kernel A's stencil (folded-coefficient form, 4 MACs/pt) against the
     flux-form scaled operator sc·A(sc·y) built from ops.stencil."""
     p = Problem(M=24, N=40)
-    cv, cs, cw, rhs, sc2, sc64 = build_canvases(p, 8)
+    cv, cs, cw, g, rhs, sc2, sc64 = build_canvases(p, 8)
     rng = np.random.RandomState(0)
 
     y_grid = np.zeros((p.M + 1, p.N + 1))
@@ -83,7 +83,7 @@ def test_kernel_a_matches_scaled_operator():
     beta = jnp.zeros((1, 1), jnp.float32)
 
     pn, ap, denom = pallas_cg.direction_and_stencil(
-        cv, beta, z, zero, cs, cw, interpret=True
+        cv, beta, z, zero, cs, cw, g, interpret=True
     )
 
     a64, b64, _, sc = host_fields64(p, True)
@@ -100,8 +100,8 @@ def test_degenerate_direction_stops_cleanly():
     """Zero RHS ⇒ zr=0, first denom=0 ⇒ degenerate guard: solver must stop
     after one iteration with w=0, not NaN."""
     p = Problem(M=16, N=16, max_iter=5)
-    cv, cs, cw, rhs, sc2, sc64 = build_canvases(p, 8)
-    s = pallas_cg._fused_solve(p, cv, True, cs, cw, jnp.zeros_like(rhs), sc2)
+    cv, cs, cw, g, rhs, sc2, sc64 = build_canvases(p, 8)
+    s = pallas_cg._fused_solve(p, cv, True, cs, cw, g, jnp.zeros_like(rhs), sc2)
     assert int(s.k) == 1
     assert bool(s.done)
     assert np.isfinite(np.asarray(s.w)).all()
