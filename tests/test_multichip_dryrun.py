@@ -19,6 +19,39 @@ _ROOT = pathlib.Path(__file__).resolve().parents[1]
 
 
 @pytest.mark.slow
+def test_dryrun_multichip_self_hosting_from_polluted_env(tmp_path):
+    """The round-1 driver trap: dryrun_multichip called from a process whose
+    ambient JAX environment is NOT a forced n-device CPU mesh (no
+    JAX_PLATFORMS, no device-count flag, and a PYTHONPATH carrying a
+    sitecustomize hook that poisons the platform selection — the axon
+    plugin's hijack mechanism). The entry point must detect this and re-exec
+    hermetically with the hook directory stripped; success at n=8 proves
+    both, because the poisoned platform cannot initialize at all and the
+    ambient process only ever sees 1 CPU device."""
+    decoy = tmp_path / "plugin_site"
+    decoy.mkdir()
+    (decoy / "sitecustomize.py").write_text(
+        "import os\nos.environ['JAX_PLATFORMS'] = 'bogus_remote_accel'\n"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = f"{_ROOT}{os.pathsep}{decoy}"
+    code = (
+        "import os, __graft_entry__ as g;"
+        "assert os.environ['JAX_PLATFORMS'] == 'bogus_remote_accel';"
+        "g.dryrun_multichip(8);"
+        "print('OUTER_OK')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=_ROOT, env=env,
+        capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OUTER_OK" in proc.stdout
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("n", [16, 32])
 def test_dryrun_multichip_scales(n):
     env = dict(os.environ)
@@ -33,7 +66,7 @@ def test_dryrun_multichip_scales(n):
     )
     proc = subprocess.run(
         [sys.executable, "-c", code], cwd=_ROOT, env=env,
-        capture_output=True, text=True, timeout=600,
+        capture_output=True, text=True, timeout=1200,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert f"OK {n}" in proc.stdout
