@@ -114,13 +114,33 @@ def main() -> int:
         print("usage: python bench.py [M N]", file=sys.stderr)
         return 2
     dtype = jnp.float32
+    # SIGALRM watchdog: the probe can pass and the tunnel wedge a moment
+    # later, turning the in-process init into a silent hang (rc=124). The
+    # alarm converts that into an exception we can downgrade to CPU.
+    # (Best-effort when bench is driven as a library: if a remote backend
+    # is already initialized and cached, the jax_platforms update cannot
+    # evict it — script mode, where _acquire_backend pins the env before
+    # the first init, is the supported hardened path.)
+    import signal
+
+    def _alarm(signum, frame):
+        raise TimeoutError("device acquisition timed out")
+
+    can_alarm = hasattr(signal, "SIGALRM")
+    if can_alarm:
+        prev = signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(int(os.environ.get("BENCH_ACQUIRE_TIMEOUT", "180")))
     try:
         devices = jax.devices()
-    except Exception as e:  # tunnel flaked between the probe and now
+    except Exception as e:  # raised init failure OR the watchdog firing
         print(f"bench: device acquisition failed ({e!r}); "
               "pinning CPU", file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
         devices = jax.devices()
+    finally:
+        if can_alarm:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, prev)
     platform = devices[0].platform
 
     def xla_run(gate=None):
