@@ -291,13 +291,11 @@ def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
         apc = ap * c
         if masked:
             apc = apc * colmask_ref[:]
-        part = jnp.sum(apc, dtype=jnp.float32)
-
-        @pl.when(i == 0)
-        def _():
-            denom_ref[0, 0] = 0.0
-
-        denom_ref[0, 0] += part
+        # Per-strip partial only: strip i owns row i of an (nb, 1) output and
+        # XLA tree-sums the partials outside the kernel. A single SMEM scalar
+        # accumulated across strips rounds serially (nb-long dependence
+        # chain), which cost 6× in L2 accuracy at 2400×3200.
+        denom_ref[0, 0] = jnp.sum(apc, dtype=jnp.float32)
 
     return kernel
 
@@ -315,25 +313,17 @@ def _make_update_kernel(masked: bool):
             colmask_ref, w_ref, r_ref, w_out_ref, r_out_ref, diff_ref, zr_ref = rest
         else:
             w_ref, r_ref, w_out_ref, r_out_ref, diff_ref, zr_ref = rest
-        i = pl.program_id(0)
         alpha = alpha_ref[0, 0]
         p = p_ref[:]
         r_new = r_ref[:] - alpha * ap_ref[:]
         w_out_ref[:] = w_ref[:] + alpha * p
         r_out_ref[:] = r_new
-        d_part = jnp.sum(p * p * sc2_ref[:], dtype=jnp.float32)
         rr = r_new * r_new
         if masked:
             rr = rr * colmask_ref[:]
-        z_part = jnp.sum(rr, dtype=jnp.float32)
-
-        @pl.when(i == 0)
-        def _():
-            diff_ref[0, 0] = 0.0
-            zr_ref[0, 0] = 0.0
-
-        diff_ref[0, 0] += d_part
-        zr_ref[0, 0] += z_part
+        # Per-strip partials (see kernel A): row i of the (nb, 1) outputs.
+        diff_ref[0, 0] = jnp.sum(p * p * sc2_ref[:], dtype=jnp.float32)
+        zr_ref[0, 0] = jnp.sum(rr, dtype=jnp.float32)
 
     return kernel
 
@@ -360,9 +350,15 @@ def _block_spec(cv: Canvas):
 
 def _scalar_spec():
     """(1,1) scalar operand in SMEM — scalar loads/stores are not legal on
-    VMEM tiles, and the cross-step accumulators must live where the scalar
-    unit can update them."""
+    VMEM tiles, and α/β are consumed by the scalar unit."""
     return pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+
+def _partial_out_spec():
+    """Row i of an (nb, 1) SMEM output: each strip's reduction partial.
+    XLA tree-sums the partials after the kernel — a serial SMEM accumulator
+    across strips loses ~6× L2 accuracy at the largest published grid."""
+    return pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM)
 
 
 def _canvas_shape(cv: Canvas, dtype):
@@ -377,7 +373,8 @@ def _colmask_spec(cv: Canvas):
 def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
                           interpret: bool,
                           band: tuple[int, int] | None = None, colmask=None):
-    """p_new, Ap, Σ Ap·p_new (unweighted) — one HBM sweep.
+    """p_new, Ap, per-strip ⟨Ap, p_new⟩ partials ((nb, 1), unweighted; caller
+    tree-sums) — one HBM sweep.
 
     ``band``/``colmask`` select the sharded variant (see the kernel factory);
     defaults are the single-device interior band with no mask."""
@@ -400,11 +397,11 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
         _make_direction_stencil_kernel(cv, band, masked),
         grid=(cv.nb,),
         in_specs=in_specs,
-        out_specs=[_block_spec(cv), _block_spec(cv), _scalar_spec()],
+        out_specs=[_block_spec(cv), _block_spec(cv), _partial_out_spec()],
         out_shape=[
             _canvas_shape(cv, p.dtype),
             _canvas_shape(cv, p.dtype),
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((cv.nb, 1), jnp.float32),
         ],
         interpret=interpret,
     )(*operands)
@@ -412,7 +409,8 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
 
 def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
                  colmask=None):
-    """w', r', Σ p²·sc², Σ r'² — one HBM sweep."""
+    """w', r', per-strip Σ p²·sc² and Σ r'² partials ((nb, 1) each; caller
+    tree-sums) — one HBM sweep."""
     masked = colmask is not None
     in_specs = [
         _scalar_spec(),
@@ -434,14 +432,14 @@ def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
         out_specs=[
             _block_spec(cv),
             _block_spec(cv),
-            _scalar_spec(),
-            _scalar_spec(),
+            _partial_out_spec(),
+            _partial_out_spec(),
         ],
         out_shape=[
             _canvas_shape(cv, w.dtype),
             _canvas_shape(cv, w.dtype),
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((cv.nb, 1), jnp.float32),
+            jax.ShapeDtypeStruct((cv.nb, 1), jnp.float32),
         ],
         input_output_aliases={w_idx: 0, w_idx + 1: 1},  # w → w', r → r'
         interpret=interpret,
@@ -474,15 +472,15 @@ def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
         pn, ap, denom_part = direction_and_stencil(
             cv, beta, s.r, s.p, cs, cw, g, interpret=interpret
         )
-        denom = denom_part[0, 0] * h1h2
+        denom = jnp.sum(denom_part) * h1h2
         degenerate = jnp.abs(denom) < _DENOM_TOL
         alpha32 = jnp.where(degenerate, 0.0, s.zr / jnp.where(degenerate, 1.0, denom))
         alpha = jnp.reshape(alpha32, (1, 1)).astype(dtype)
         w, r, diff_part, zr_part = fused_update(
             cv, alpha, pn, ap, sc2, s.w, s.r, interpret=interpret
         )
-        diff = jnp.abs(alpha32) * jnp.sqrt(diff_part[0, 0] * norm_w)
-        zr_new = zr_part[0, 0] * h1h2
+        diff = jnp.abs(alpha32) * jnp.sqrt(jnp.sum(diff_part) * norm_w)
+        zr_new = jnp.sum(zr_part) * h1h2
         converged = diff < problem.delta
         return _FusedState(
             k=s.k + 1,

@@ -214,7 +214,7 @@ def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
         pn = pn.at[lo - 1, :].set(s.r[lo - 1, :] + b * s.p[lo - 1, :])
         pn = pn.at[hi, :].set(s.r[hi, :] + b * s.p[hi, :])
 
-        denom = psum(denom_part[0, 0]) * h1h2
+        denom = psum(jnp.sum(denom_part)) * h1h2
         degenerate = jnp.abs(denom) < _DENOM_TOL
         alpha32 = jnp.where(
             degenerate, 0.0, s.zr / jnp.where(degenerate, 1.0, denom)
@@ -225,8 +225,8 @@ def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
             cv, alpha, pn, ap, sc2, s.w, s.r, interpret=interpret,
             colmask=colmask,
         )
-        diff = jnp.abs(alpha32) * jnp.sqrt(psum(diff_part[0, 0]) * norm_w)
-        zr_new = psum(zr_part[0, 0]) * h1h2
+        diff = jnp.abs(alpha32) * jnp.sqrt(psum(jnp.sum(diff_part)) * norm_w)
+        zr_new = psum(jnp.sum(zr_part)) * h1h2
         converged = diff < problem.delta
 
         r = _exchange_r_halo(r, spec, px, py)

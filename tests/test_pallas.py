@@ -90,9 +90,10 @@ def test_kernel_a_matches_scaled_operator():
     want = sc * apply_A(sc * y_grid, a64, b64, p.h1, p.h2)
     got = np.asarray(ap)[HALO : HALO + p.M - 1, : p.N + 1]
     np.testing.assert_allclose(got, want[1:-1, :], atol=1e-5)
-    # and the fused dot partial is ⟨Ap, p⟩ (unweighted)
+    # and the per-strip dot partials sum to ⟨Ap, p⟩ (unweighted)
+    assert denom.shape == (cv.nb, 1)
     np.testing.assert_allclose(
-        float(denom[0, 0]), float((want[1:-1] * y_grid[1:-1]).sum()), rtol=1e-5
+        float(denom.sum()), float((want[1:-1] * y_grid[1:-1]).sum()), rtol=1e-5
     )
 
 
