@@ -76,7 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="timed solve repetitions; report the best")
     p.add_argument("--checkpoint", metavar="PATH", default=None,
                    help="persist solver state to PATH every --chunk "
-                        "iterations and resume from it (xla backend)")
+                        "iterations and resume from it (xla and sharded "
+                        "backends; checkpoints are portable between them "
+                        "and across mesh shapes)")
     p.add_argument("--chunk", type=int, default=200,
                    help="iterations between checkpoints (default 200)")
     p.add_argument("--save-solution", metavar="PATH", default=None,
@@ -125,7 +127,10 @@ def _pick_backend(args) -> str:
     devices = jax.devices()
     tpu = devices[0].platform == "tpu"
     if args.checkpoint:
-        return "xla"  # the checkpointed solver drives the XLA path
+        # The checkpointed solvers drive the XLA paths (single or sharded).
+        if len(devices) > 1 or args.mesh is not None:
+            return "sharded"
+        return "xla"
     if len(devices) > 1 or args.mesh is not None:
         # pallas-sharded builds its canvases on the host; an explicit
         # --setup device request keeps the XLA sharded path.
@@ -171,6 +176,18 @@ def _run_jax(args, problem: Problem, backend: str):
                     "host; use --backend sharded for --setup device"
                 )
             run = lambda: pallas_cg_solve_sharded(problem, mesh)
+        elif args.checkpoint:
+            if args.setup == "device":
+                raise SystemExit(
+                    "--checkpoint gathers state on the host; use the "
+                    "default --setup host"
+                )
+            from poisson_tpu.parallel import pcg_solve_sharded_checkpointed
+
+            run = lambda: pcg_solve_sharded_checkpointed(
+                problem, mesh, args.checkpoint, chunk=args.chunk,
+                dtype=args.dtype,
+            )
         else:
             run = lambda: pcg_solve_sharded(
                 problem, mesh, dtype=args.dtype, setup=args.setup
@@ -282,10 +299,15 @@ def main(argv=None) -> int:
     problem = _problem(args)
     if args.categories and args.json:
         raise SystemExit("--categories produces a table; drop --json")
-    if args.checkpoint and args.backend not in ("auto", "xla"):
-        raise SystemExit("--checkpoint is supported on the xla backend")
-    if args.checkpoint and args.mesh is not None:
-        raise SystemExit("--checkpoint runs single-device; drop --mesh")
+    if args.checkpoint and args.backend not in ("auto", "xla", "sharded"):
+        raise SystemExit(
+            "--checkpoint is supported on the xla and sharded backends"
+        )
+    if args.checkpoint and args.backend == "xla" and args.mesh is not None:
+        raise SystemExit(
+            "--backend xla --checkpoint runs single-device; drop --mesh or "
+            "use --backend sharded"
+        )
 
     if args.dtype == "float64" and args.backend != "native":
         import jax

@@ -1,3 +1,4 @@
+from poisson_tpu.parallel.checkpoint_sharded import pcg_solve_sharded_checkpointed
 from poisson_tpu.parallel.mesh import choose_process_grid, make_solver_mesh
 from poisson_tpu.parallel.pcg_sharded import pcg_solve_sharded
 
@@ -6,6 +7,7 @@ __all__ = [
     "make_solver_mesh",
     "pallas_cg_solve_sharded",
     "pcg_solve_sharded",
+    "pcg_solve_sharded_checkpointed",
 ]
 
 

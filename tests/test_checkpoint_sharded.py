@@ -1,0 +1,108 @@
+"""Sharded checkpoint/resume: chunked sharded solves equal one-shot sharded
+solves, a killed run resumes from the last chunk boundary, and checkpoints
+are portable across mesh shapes and between the sharded and single-device
+solvers (elastic recovery — no reference analog, SURVEY §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poisson_tpu.config import Problem
+from poisson_tpu.parallel import (
+    make_solver_mesh,
+    pcg_solve_sharded,
+    pcg_solve_sharded_checkpointed,
+)
+from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
+from poisson_tpu.solvers.pcg import pcg_solve
+
+
+def test_chunked_equals_oneshot_sharded(tmp_path):
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices())
+    ref = pcg_solve_sharded(p, mesh)
+    got = pcg_solve_sharded_checkpointed(p, mesh, str(tmp_path / "ck.npz"),
+                                         chunk=7)
+    assert int(got.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=0, atol=1e-12
+    )
+    assert not (tmp_path / "ck.npz").exists()  # converged → cleaned up
+
+
+def test_kill_and_resume_on_mesh(tmp_path):
+    """Simulated preemption on the 8-device mesh: cap the budget, then rerun
+    uncapped — the resume converges to the one-shot answer."""
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices())
+    path = str(tmp_path / "ck.npz")
+
+    partial = pcg_solve_sharded_checkpointed(p.with_(max_iter=20), mesh,
+                                             path, chunk=10)
+    assert int(partial.iterations) == 20
+    assert (tmp_path / "ck.npz").exists()  # unconverged cap-hit keeps it
+
+    ref = pcg_solve_sharded(p, mesh)
+    resumed = pcg_solve_sharded_checkpointed(p, mesh, path, chunk=10)
+    assert int(resumed.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(resumed.w), np.asarray(ref.w), rtol=0, atol=1e-12
+    )
+    assert not (tmp_path / "ck.npz").exists()
+
+
+def test_chunked_fp32_scaled_path(tmp_path):
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices())
+    ref = pcg_solve_sharded(p, mesh, dtype=jnp.float32)
+    got = pcg_solve_sharded_checkpointed(p, mesh, str(tmp_path / "ck.npz"),
+                                         chunk=13, dtype=jnp.float32)
+    assert int(got.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=0, atol=1e-6
+    )
+
+
+def test_checkpoint_portable_across_mesh_shapes(tmp_path):
+    """A solve interrupted on a 2x4 mesh resumes on a 4x2 mesh — the
+    restart-shape elasticity the reference's fixed-P MPI world lacked."""
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    mesh_a = make_solver_mesh(jax.devices(), grid=(2, 4))
+    mesh_b = make_solver_mesh(jax.devices(), grid=(4, 2))
+
+    pcg_solve_sharded_checkpointed(p.with_(max_iter=20), mesh_a, path, chunk=10)
+    ref = pcg_solve_sharded(p, mesh_b)
+    resumed = pcg_solve_sharded_checkpointed(p, mesh_b, path, chunk=10)
+    assert int(resumed.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(resumed.w), np.asarray(ref.w), rtol=0, atol=1e-9
+    )
+
+
+def test_checkpoint_portable_mesh_to_single_device(tmp_path):
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    mesh = make_solver_mesh(jax.devices())
+
+    pcg_solve_sharded_checkpointed(p.with_(max_iter=15), mesh, path, chunk=5)
+    ref = pcg_solve(p)
+    resumed = pcg_solve_checkpointed(p, path, chunk=50)
+    assert int(resumed.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(resumed.w), np.asarray(ref.w), rtol=0, atol=1e-9
+    )
+
+
+def test_checkpoint_portable_single_device_to_mesh(tmp_path):
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    mesh = make_solver_mesh(jax.devices())
+
+    pcg_solve_checkpointed(p.with_(max_iter=15), path, chunk=5)
+    ref = pcg_solve_sharded(p, mesh)
+    resumed = pcg_solve_sharded_checkpointed(p, mesh, path, chunk=50)
+    assert int(resumed.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(resumed.w), np.asarray(ref.w), rtol=0, atol=1e-9
+    )

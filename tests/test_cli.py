@@ -53,3 +53,24 @@ def test_table_output_and_categories(capsys):
 def test_bad_mesh_spec_rejected():
     with pytest.raises(SystemExit):
         build_parser().parse_args(["40", "40", "--mesh", "banana"])
+
+
+def test_sharded_checkpoint_cli(capsys, tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    assert main(["40", "40", "--backend", "sharded", "--mesh", "2x4",
+                 "--checkpoint", ck, "--chunk", "10", "--json"]) == 0
+    rec = _json_line(capsys)
+    assert rec["iterations"] == 50
+    assert rec["mesh"] == [2, 4]
+
+
+def test_checkpoint_rejects_fused_backends():
+    with pytest.raises(SystemExit):
+        main(["40", "40", "--backend", "pallas", "--checkpoint", "/tmp/x.npz"])
+    with pytest.raises(SystemExit):
+        main(["40", "40", "--backend", "sharded", "--setup", "device",
+              "--checkpoint", "/tmp/x.npz"])
+    # Explicit xla + mesh + checkpoint must error, not silently drop --mesh.
+    with pytest.raises(SystemExit):
+        main(["40", "40", "--backend", "xla", "--mesh", "2x4",
+              "--checkpoint", "/tmp/x.npz"])

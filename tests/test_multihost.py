@@ -51,6 +51,27 @@ result = pcg_solve_sharded(
 iters = int(result.iterations)      # mesh-replicated: fetchable everywhere
 assert iters == 50, iters           # the 40x40 weighted-norm golden
 assert float(result.diff) < 1e-6
+
+# Checkpointed sharded solve across the process boundary: host-setup blocks
+# re-wrapped as global arrays, state all-gathered before the primary-only
+# write, barrier-ordered file handoff, capped run resumed to convergence.
+import os
+from poisson_tpu.parallel import pcg_solve_sharded_checkpointed
+
+ck = sys.argv[3]
+p40 = Problem(M=40, N=40)
+partial = pcg_solve_sharded_checkpointed(
+    p40.with_(max_iter=20), mesh, ck, chunk=10, dtype="float64"
+)
+assert int(partial.iterations) == 20, int(partial.iterations)
+assert os.path.exists(ck)           # unconverged cap-hit keeps the file
+resumed = pcg_solve_sharded_checkpointed(
+    p40, mesh, ck, chunk=10, dtype="float64"
+)
+assert int(resumed.iterations) == 50, int(resumed.iterations)
+assert float(resumed.diff) < 1e-6
+if is_primary():
+    assert not os.path.exists(ck)   # converged -> primary cleaned up
 print(f"RANK{rank}_OK", flush=True)
 """
 
@@ -62,15 +83,16 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_distributed_solve():
+def test_two_process_distributed_solve(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["PYTHONPATH"] = str(_ROOT)
     coord = f"localhost:{_free_port()}"
+    ck = str(tmp_path / "ck.npz")
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, coord, str(rank)],
+            [sys.executable, "-c", _WORKER, coord, str(rank), ck],
             cwd=_ROOT, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
