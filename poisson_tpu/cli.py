@@ -76,9 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="timed solve repetitions; report the best")
     p.add_argument("--checkpoint", metavar="PATH", default=None,
                    help="persist solver state to PATH every --chunk "
-                        "iterations and resume from it (xla and sharded "
-                        "backends; checkpoints are portable between them "
-                        "and across mesh shapes)")
+                        "iterations and resume from it (every JAX backend; "
+                        "fp32 checkpoints are portable across backends and "
+                        "mesh shapes)")
     p.add_argument("--chunk", type=int, default=200,
                    help="iterations between checkpoints (default 200)")
     p.add_argument("--save-solution", metavar="PATH", default=None,
@@ -126,11 +126,8 @@ def _pick_backend(args) -> str:
         return args.backend
     devices = jax.devices()
     tpu = devices[0].platform == "tpu"
-    if args.checkpoint:
-        # The checkpointed solvers drive the XLA paths (single or sharded).
-        if len(devices) > 1 or args.mesh is not None:
-            return "sharded"
-        return "xla"
+    # --checkpoint needs no special-casing: every JAX backend auto-pick can
+    # reach (pallas, pallas-sharded, sharded, xla) has a checkpointed driver.
     if len(devices) > 1 or args.mesh is not None:
         # pallas-sharded builds its canvases on the host; an explicit
         # --setup device request keeps the XLA sharded path.
@@ -175,7 +172,16 @@ def _run_jax(args, problem: Problem, backend: str):
                     "--backend pallas-sharded builds its canvases on the "
                     "host; use --backend sharded for --setup device"
                 )
-            run = lambda: pallas_cg_solve_sharded(problem, mesh)
+            if args.checkpoint:
+                from poisson_tpu.parallel import (
+                    pallas_cg_solve_sharded_checkpointed,
+                )
+
+                run = lambda: pallas_cg_solve_sharded_checkpointed(
+                    problem, mesh, args.checkpoint, chunk=args.chunk
+                )
+            else:
+                run = lambda: pallas_cg_solve_sharded(problem, mesh)
         elif args.checkpoint:
             if args.setup == "device":
                 raise SystemExit(
@@ -199,9 +205,16 @@ def _run_jax(args, problem: Problem, backend: str):
                 "--backend pallas is the fp32 fused path; use --backend xla "
                 "for float64"
             )
-        from poisson_tpu.ops.pallas_cg import pallas_cg_solve
+        if args.checkpoint:
+            from poisson_tpu.ops.pallas_cg import pallas_cg_solve_checkpointed
 
-        run = lambda: pallas_cg_solve(problem)
+            run = lambda: pallas_cg_solve_checkpointed(
+                problem, args.checkpoint, chunk=args.chunk
+            )
+        else:
+            from poisson_tpu.ops.pallas_cg import pallas_cg_solve
+
+            run = lambda: pallas_cg_solve(problem)
         n_dev = 1
     elif args.checkpoint:
         from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
@@ -299,9 +312,9 @@ def main(argv=None) -> int:
     problem = _problem(args)
     if args.categories and args.json:
         raise SystemExit("--categories produces a table; drop --json")
-    if args.checkpoint and args.backend not in ("auto", "xla", "sharded"):
+    if args.checkpoint and args.backend == "native":
         raise SystemExit(
-            "--checkpoint is supported on the xla and sharded backends"
+            "--checkpoint is supported on the JAX backends, not native"
         )
     if args.checkpoint and args.backend == "xla" and args.mesh is not None:
         raise SystemExit(

@@ -10,10 +10,10 @@ from poisson_tpu.ops.stencil import (
 def __getattr__(name):
     # Lazy: pallas_cg imports solvers.pcg, which imports ops.stencil — an
     # eager import here would close that cycle during package init.
-    if name == "pallas_cg_solve":
-        from poisson_tpu.ops.pallas_cg import pallas_cg_solve
+    if name in ("pallas_cg_solve", "pallas_cg_solve_checkpointed"):
+        from poisson_tpu.ops import pallas_cg
 
-        return pallas_cg_solve
+        return getattr(pallas_cg, name)
     raise AttributeError(name)
 
 
@@ -25,4 +25,5 @@ __all__ = [
     "interior",
     "pad_interior",
     "pallas_cg_solve",
+    "pallas_cg_solve_checkpointed",
 ]

@@ -76,7 +76,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from poisson_tpu.config import Problem
-from poisson_tpu.solvers.pcg import PCGResult, _DENOM_TOL, host_fields64
+from poisson_tpu.solvers.pcg import (
+    PCGResult,
+    PCGState,
+    _DENOM_TOL,
+    host_fields64,
+)
 
 LANE = 128      # TPU lane width: canvas columns padded to a multiple of this
 SUBLANE = 8     # fp32 sublane granule: strip heights in multiples of this
@@ -457,15 +462,13 @@ class _FusedState(NamedTuple):
     diff: jnp.ndarray
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
-                 cs, cw, g, rhs, sc2):
+def _make_fused_body(problem: Problem, cv: Canvas, interpret: bool,
+                     cs, cw, g, sc2, dtype):
+    """One fused iteration (kernels A + B) as a pure state→state function —
+    shared by the convergence while_loop and the chunked checkpointed
+    solve."""
     h1h2 = jnp.float32(problem.h1 * problem.h2)
     norm_w = h1h2 if problem.weighted_norm else jnp.float32(1.0)
-    dtype = rhs.dtype
-
-    w0 = jnp.zeros((cv.rows, cv.cols), dtype)
-    zr0 = jnp.sum(rhs.astype(jnp.float32) ** 2) * h1h2
 
     def body(s: _FusedState) -> _FusedState:
         beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
@@ -491,17 +494,34 @@ def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
             diff=diff,
         )
 
-    def cond(s: _FusedState):
-        return (~s.done) & (s.k < problem.iteration_cap)
+    return body
 
-    init = _FusedState(
+
+def _fused_init(cv: Canvas, rhs) -> _FusedState:
+    """w=0, r=b̃, p=0 with β=0 (the first sweep then forms p ← z + 0·p = z₀),
+    ζ₀ = Σ b̃² (z = r on the scaled system)."""
+    w0 = jnp.zeros((cv.rows, cv.cols), rhs.dtype)
+    return _FusedState(
         k=jnp.zeros((), jnp.int32),
         done=jnp.asarray(False),
         w=w0, r=rhs, p=w0,
-        zr=zr0,
-        beta=jnp.float32(0.0),   # first iteration: p ← z + 0·p = z₀
+        zr=jnp.sum(rhs.astype(jnp.float32) ** 2),   # caller scales by h1h2
+        beta=jnp.float32(0.0),
         diff=jnp.float32(jnp.inf),
     )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
+                 cs, cw, g, rhs, sc2):
+    dtype = rhs.dtype
+    body = _make_fused_body(problem, cv, interpret, cs, cw, g, sc2, dtype)
+
+    def cond(s: _FusedState):
+        return (~s.done) & (s.k < problem.iteration_cap)
+
+    init = _fused_init(cv, rhs)
+    init = init._replace(zr=init.zr * jnp.float32(problem.h1 * problem.h2))
     return lax.while_loop(cond, body, init)
 
 
@@ -553,6 +573,125 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     s = _fused_solve(problem, cv, interpret, cs, cw, g, rhs, sc2)
     # Canvas → full-grid solution, unscaled: w = sc · y.
+    M, N = problem.M, problem.N
+    y = s.w[HALO : HALO + M - 1, 1:N]
+    w = jnp.pad(y * sc_int, 1)
+    return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint/resume on the fused path (see solvers.checkpoint for the format).
+#
+# The .npz layout is the portable full-grid PCGState the XLA checkpointed
+# solvers write, under the (dtype="float32", scaled=True) fingerprint — so a
+# fused-path checkpoint resumes on the XLA fp32-scaled path (single-device or
+# sharded) and vice versa. State mapping: the fused loop carries the
+# *previous* direction plus the pending β (applied at the top of kernel A),
+# while PCGState carries the fully-updated direction d = z + β·p. Saving
+# forms d = r + β·p (z = r on the scaled system); resuming inverts it with
+# p := d − r, β := 1 (then r + 1·(d − r) = d, exact to one ulp per element).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _fused_chunk(problem: Problem, cv: Canvas, interpret: bool, chunk: int,
+                 cs, cw, g, sc2, s: _FusedState) -> _FusedState:
+    """Advance the fused solve by at most ``chunk`` iterations."""
+    body = _make_fused_body(problem, cv, interpret, cs, cw, g, sc2,
+                            s.r.dtype)
+    stop_at = jnp.minimum(s.k + chunk, problem.iteration_cap)
+
+    def cond(st: _FusedState):
+        return (~st.done) & (st.k < stop_at)
+
+    return lax.while_loop(cond, body, s)
+
+
+def _canvas_to_full(problem: Problem, c) -> np.ndarray:
+    """Canvas interior rows → the full (M+1, N+1) grid (zero ring; canvas
+    ring columns are zero by the maskless invariant)."""
+    M, N = problem.M, problem.N
+    c = np.asarray(c)
+    full = np.zeros((M + 1, N + 1), c.dtype)
+    full[1:M, :] = c[HALO : HALO + M - 1, : N + 1]
+    return full
+
+
+def _full_to_canvas(problem: Problem, cv: Canvas, full) -> jnp.ndarray:
+    M, N = problem.M, problem.N
+    full = np.asarray(full)
+    c = np.zeros((cv.rows, cv.cols), full.dtype)
+    c[HALO : HALO + M - 1, : N + 1] = full[1:M, :]
+    return jnp.asarray(c)
+
+
+def _fused_to_pcg_state(problem: Problem, cv: Canvas,
+                        s: _FusedState) -> PCGState:
+    """Fused state → the portable full-grid PCGState (y-space, z = r)."""
+    r = np.asarray(s.r)
+    d = r + float(s.beta) * np.asarray(s.p)   # updated direction z + β·p
+    r_full = _canvas_to_full(problem, s.r)
+    return PCGState(
+        k=np.asarray(s.k), done=np.asarray(s.done),
+        w=_canvas_to_full(problem, s.w), r=r_full, z=r_full,
+        p=_canvas_to_full(problem, d),
+        zr=np.asarray(s.zr), diff=np.asarray(s.diff),
+    )
+
+
+def _pcg_state_to_fused(problem: Problem, cv: Canvas,
+                        state: PCGState) -> _FusedState:
+    """Portable PCGState → fused state: p := d − r with β := 1."""
+    d = np.asarray(state.p, np.float32)
+    r = np.asarray(state.r, np.float32)
+    return _FusedState(
+        k=jnp.asarray(state.k, jnp.int32),
+        done=jnp.asarray(np.asarray(state.done), bool),
+        w=_full_to_canvas(problem, cv, np.asarray(state.w, np.float32)),
+        r=_full_to_canvas(problem, cv, r),
+        p=_full_to_canvas(problem, cv, d - r),
+        zr=jnp.asarray(np.asarray(state.zr), jnp.float32),
+        beta=jnp.float32(1.0),
+        diff=jnp.asarray(np.asarray(state.diff), jnp.float32),
+    )
+
+
+def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
+                                 chunk: int = 200, bm: int | None = None,
+                                 interpret: bool | None = None,
+                                 keep_checkpoint: bool = False) -> PCGResult:
+    """Fused-path solve with periodic state persistence and automatic
+    resume — interoperable with the XLA fp32-scaled checkpoints (module
+    comment above). fp32 only, like the fused path itself."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    from poisson_tpu.solvers.checkpoint import (
+        _fingerprint,
+        load_state,
+        run_chunked,
+    )
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    cv, cs, cw, g, rhs, sc2, sc_int = build_canvases(problem, bm, "float32")
+    fp = _fingerprint(problem, "float32", True)
+
+    saved = load_state(checkpoint_path, fp)
+    if saved is None:
+        s = _fused_init(cv, rhs)
+        s = s._replace(zr=s.zr * jnp.float32(problem.h1 * problem.h2))
+    else:
+        s = _pcg_state_to_fused(problem, cv, saved)
+
+    s = run_chunked(
+        s,
+        advance=lambda st: _fused_chunk(problem, cv, interpret, chunk,
+                                        cs, cw, g, sc2, st),
+        to_portable=lambda st: _fused_to_pcg_state(problem, cv, st),
+        path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
+        keep_checkpoint=keep_checkpoint,
+    )
+
     M, N = problem.M, problem.N
     y = s.w[HALO : HALO + M - 1, 1:N]
     w = jnp.pad(y * sc_int, 1)

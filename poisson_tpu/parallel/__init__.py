@@ -6,6 +6,7 @@ __all__ = [
     "choose_process_grid",
     "make_solver_mesh",
     "pallas_cg_solve_sharded",
+    "pallas_cg_solve_sharded_checkpointed",
     "pcg_solve_sharded",
     "pcg_solve_sharded_checkpointed",
 ]
@@ -14,8 +15,9 @@ __all__ = [
 def __getattr__(name):
     # Lazy: keep jax.experimental.pallas out of plain-XLA consumers'
     # import path (matching the deferred imports in bench/cli/sweep).
-    if name == "pallas_cg_solve_sharded":
-        from poisson_tpu.parallel.pallas_sharded import pallas_cg_solve_sharded
+    if name in ("pallas_cg_solve_sharded",
+                "pallas_cg_solve_sharded_checkpointed"):
+        from poisson_tpu.parallel import pallas_sharded
 
-        return pallas_cg_solve_sharded
+        return getattr(pallas_sharded, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

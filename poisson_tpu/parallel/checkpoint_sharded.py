@@ -34,7 +34,6 @@ idiom), and a cross-process sync orders the write before any later read.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -52,7 +51,7 @@ from poisson_tpu.parallel.pcg_sharded import (
 from poisson_tpu.solvers.checkpoint import (
     _fingerprint,
     load_state,
-    save_state,
+    run_chunked,
 )
 from poisson_tpu.solvers.pcg import (
     PCGResult,
@@ -93,16 +92,22 @@ def _global_array(host: np.ndarray, mesh: Mesh, spec) -> jnp.ndarray:
     )
 
 
+@functools.lru_cache(maxsize=8)
+def _replicator(mesh: Mesh):
+    """Cached jitted identity that reshards its argument to fully-replicated
+    — one trace/compile per mesh, not per checkpoint boundary."""
+    return jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+
+
 def _fetchable(state: PCGState, mesh: Mesh) -> PCGState:
     """Reshard the state arrays to fully-replicated so ``np.asarray`` is
     legal on every process (multi-process state spans non-addressable
     devices). All processes must call this together — it is a collective."""
     if not _multiprocess():
         return state
-    rep = jax.jit(lambda w, r, z, p: (w, r, z, p),
-                  out_shardings=NamedSharding(mesh, P()))
-    w, r, z, p = rep(state.w, state.r, state.z, state.p)
-    return state._replace(w=w, r=r, z=z, p=p)
+    rep = _replicator(mesh)
+    return state._replace(w=rep(state.w), r=rep(state.r),
+                          z=rep(state.z), p=rep(state.p))
 
 
 def _geometry(problem: Problem, mesh: Mesh):
@@ -261,20 +266,14 @@ def pcg_solve_sharded_checkpointed(problem: Problem, mesh: Mesh,
         state = _to_padded_global(saved, problem,
                                   px_size * m_blk, py_size * n_blk, mesh)
 
-    while (not bool(state.done)) and int(state.k) < problem.iteration_cap:
-        state = _chunk_sharded(problem, mesh, use_scaled, chunk,
-                               a_blk, b_blk, aux_blk, state)
-        jax.block_until_ready(state)
-        full = _to_full_grid(_fetchable(state, mesh), problem)
-        if is_primary():
-            save_state(checkpoint_path, full, fp)
-        _sync("poisson_ckpt_save")   # write lands before anyone reads it
-
-    converged = bool(state.done)
-    if converged and not keep_checkpoint and is_primary() \
-            and os.path.exists(checkpoint_path):
-        os.remove(checkpoint_path)
-    _sync("poisson_ckpt_done")       # removal precedes any follow-up solve
+    state = run_chunked(
+        state,
+        advance=lambda s: _chunk_sharded(problem, mesh, use_scaled, chunk,
+                                         a_blk, b_blk, aux_blk, s),
+        to_portable=lambda s: _to_full_grid(_fetchable(s, mesh), problem),
+        path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
+        keep_checkpoint=keep_checkpoint, primary=is_primary, sync=_sync,
+    )
 
     # Solution extraction, matching pcg_solve_sharded: unscale with the same
     # cast-to-device-dtype scaling vector the sharded ops used.

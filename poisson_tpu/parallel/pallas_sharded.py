@@ -189,10 +189,11 @@ def _exchange_r_halo(r, spec: ShardSpec, px: int, py: int):
     return r.at[:, 0].set(left).at[:, spec.n_blk + 1].set(right)
 
 
-def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
-               interpret: bool, cs, cw, g, rhs, sc2, sc_int, colmask):
+def _make_shard_body(problem: Problem, spec: ShardSpec, px: int, py: int,
+                     interpret: bool, cs, cw, g, sc2, colmask, dtype):
+    """One fused sharded iteration as a pure state→state function — shared
+    by the convergence while_loop and the chunked checkpointed solve."""
     cv = spec.cv
-    dtype = rhs.dtype
     h1h2 = jnp.float32(problem.h1 * problem.h2)
     norm_w = h1h2 if problem.weighted_norm else jnp.float32(1.0)
     band = (HALO - 1, HALO + spec.m_blk + 1)  # owned rows + halo ring
@@ -239,13 +240,20 @@ def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
             diff=diff,
         )
 
-    def cond(s: _State):
-        return (~s.done) & (s.k < problem.iteration_cap)
+    return body
 
-    zeros = jnp.zeros((cv.rows, cv.cols), dtype)
+
+def _shard_init(problem: Problem, spec: ShardSpec, rhs, colmask) -> _State:
+    """w=0, r=b̃ (halo ring seeded by the rhs canvas), p=0 with β=0."""
+    cv = spec.cv
+    lo, hi = HALO, HALO + spec.m_blk
+    h1h2 = jnp.float32(problem.h1 * problem.h2)
+    zeros = jnp.zeros((cv.rows, cv.cols), rhs.dtype)
     center = rhs[lo:hi, :].astype(jnp.float32)
-    zr0 = psum(jnp.sum(center * center * colmask.astype(jnp.float32))) * h1h2
-    init = _State(
+    zr0 = lax.psum(
+        jnp.sum(center * center * colmask.astype(jnp.float32)), _AXES
+    ) * h1h2
+    return _State(
         k=jnp.zeros((), jnp.int32),
         done=jnp.asarray(False),
         w=zeros, r=rhs, p=zeros,
@@ -253,7 +261,18 @@ def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
         beta=jnp.float32(0.0),   # first iteration: p ← z + 0·p = z₀ = r₀
         diff=jnp.float32(jnp.inf),
     )
-    s = lax.while_loop(cond, body, init)
+
+
+def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
+               interpret: bool, cs, cw, g, rhs, sc2, sc_int, colmask):
+    lo, hi = HALO, HALO + spec.m_blk
+    body = _make_shard_body(problem, spec, px, py, interpret,
+                            cs, cw, g, sc2, colmask, rhs.dtype)
+
+    def cond(s: _State):
+        return (~s.done) & (s.k < problem.iteration_cap)
+
+    s = lax.while_loop(cond, body, _shard_init(problem, spec, rhs, colmask))
     w_own = s.w[lo:hi, 1 : spec.n_blk + 1] * sc_int
     return w_own, s.k, s.diff, s.zr
 
@@ -307,3 +326,238 @@ def pallas_cg_solve_sharded(problem: Problem, mesh: Mesh,
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     return _solve(problem, mesh, spec, interpret,
                   cs, cw, g, rhs, sc2, sc_int, colmask)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint/resume on the distributed fused path. Same portable full-grid
+# .npz format and (float32, scaled) fingerprint as every other checkpointed
+# solver — a pod-scale fused solve can be resumed by the XLA paths, on a
+# different mesh shape, or single-device (see ops.pallas_cg and
+# parallel.checkpoint_sharded). Fused-state mapping as in ops.pallas_cg:
+# save forms the updated direction d = r + β·p; resume sets p := d − r,
+# β := 1. Halo rings are dropped at save and refreshed by one exchange at
+# chunk start (idempotent for in-memory state: the exchanged values equal
+# the locally-recomputed ones by the r-halo induction argument above).
+# ---------------------------------------------------------------------------
+
+
+def _gather_full(problem: Problem, spec: ShardSpec, px: int, py: int,
+                 stacked) -> np.ndarray:
+    """Stacked per-shard canvases → owned interiors on the (M+1, N+1) grid."""
+    M, N = problem.M, problem.N
+    stacked = np.asarray(stacked)
+    full = np.zeros((M + 1, N + 1), stacked.dtype)
+    for ix in range(px):
+        for iy in range(py):
+            gi0, gj0 = 1 + ix * spec.m_blk, 1 + iy * spec.n_blk
+            nr = min(spec.m_blk, M - gi0)
+            nc = min(spec.n_blk, N - gj0)
+            if nr <= 0 or nc <= 0:
+                continue
+            blk = stacked[ix * py + iy]
+            full[gi0 : gi0 + nr, gj0 : gj0 + nc] = blk[
+                HALO : HALO + nr, 1 : 1 + nc
+            ]
+    return full
+
+
+def _scatter_canvases(problem: Problem, spec: ShardSpec, px: int, py: int,
+                      full) -> np.ndarray:
+    """(M+1, N+1) grid → stacked per-shard canvases, owned interiors only
+    (halo rings and padding zero; one exchange at chunk start refreshes)."""
+    M, N = problem.M, problem.N
+    cv = spec.cv
+    full = np.asarray(full, np.float32)
+    out = np.zeros((px * py, cv.rows, cv.cols), np.float32)
+    for ix in range(px):
+        for iy in range(py):
+            gi0, gj0 = 1 + ix * spec.m_blk, 1 + iy * spec.n_blk
+            nr = min(spec.m_blk, M - gi0)
+            nc = min(spec.n_blk, N - gj0)
+            if nr <= 0 or nc <= 0:
+                continue
+            out[ix * py + iy, HALO : HALO + nr, 1 : 1 + nc] = full[
+                gi0 : gi0 + nr, gj0 : gj0 + nc
+            ]
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _chunk_solve(problem: Problem, mesh: Mesh, spec: ShardSpec,
+                 interpret: bool, chunk: int, cs, cw, g, sc2, colmask,
+                 w_st, r_st, p_st, k, done, zr, beta, diff):
+    px = mesh.shape[X_AXIS]
+    py = mesh.shape[Y_AXIS]
+
+    def shard_fn(cs_b, cw_b, g_b, sc2_b, colmask_b,
+                 w_b, r_b, p_b, k, done, zr, beta, diff):
+        body = _make_shard_body(problem, spec, px, py, interpret,
+                                cs_b[0], cw_b[0], g_b[0], sc2_b[0],
+                                colmask_b, w_b.dtype)
+        # Refresh halo rings (resume reconstructs them as zeros; for
+        # in-memory state the exchange is value-idempotent).
+        r = _exchange_r_halo(r_b[0], spec, px, py)
+        p = _exchange_r_halo(p_b[0], spec, px, py)
+        s0 = _State(k=k, done=done, w=w_b[0], r=r, p=p,
+                    zr=zr, beta=beta, diff=diff)
+        stop_at = jnp.minimum(k + chunk, problem.iteration_cap)
+
+        def cond(s: _State):
+            return (~s.done) & (s.k < stop_at)
+
+        s = lax.while_loop(cond, body, s0)
+        return (s.w[None], s.r[None], s.p[None],
+                s.k, s.done, s.zr, s.beta, s.diff)
+
+    stacked = P((X_AXIS, Y_AXIS))
+    rep = P()
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(stacked, stacked, stacked, stacked, rep,
+                  stacked, stacked, stacked, rep, rep, rep, rep, rep),
+        out_specs=(stacked, stacked, stacked, rep, rep, rep, rep, rep),
+        check_vma=False,
+    )(cs, cw, g, sc2, colmask, w_st, r_st, p_st, k, done, zr, beta, diff)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _init_stacked(problem: Problem, mesh: Mesh, spec: ShardSpec,
+                  rhs, colmask):
+    def shard_fn(rhs_b, colmask_b):
+        s = _shard_init(problem, spec, rhs_b[0], colmask_b)
+        return (s.w[None], s.r[None], s.p[None],
+                s.k, s.done, s.zr, s.beta, s.diff)
+
+    stacked = P((X_AXIS, Y_AXIS))
+    rep = P()
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(stacked, rep),
+        out_specs=(stacked, stacked, stacked, rep, rep, rep, rep, rep),
+        check_vma=False,
+    )(rhs, colmask)
+
+
+class _CkptState(NamedTuple):
+    """Stacked-canvas fused state as seen by the shared checkpoint driver."""
+
+    w: jnp.ndarray
+    r: jnp.ndarray
+    p: jnp.ndarray
+    k: jnp.ndarray
+    done: jnp.ndarray
+    zr: jnp.ndarray
+    beta: jnp.ndarray
+    diff: jnp.ndarray
+
+
+def pallas_cg_solve_sharded_checkpointed(
+        problem: Problem, mesh: Mesh, checkpoint_path: str,
+        chunk: int = 200, bm: int | None = None,
+        interpret: bool | None = None,
+        keep_checkpoint: bool = False) -> PCGResult:
+    """Distributed fused-path solve with periodic state persistence and
+    automatic resume (portable format — see module comment). fp32 only.
+    Multi-process meshes: state is gathered to every process before the
+    primary-only write, with barrier-ordered file handoff."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    from poisson_tpu.parallel.checkpoint_sharded import (
+        _global_array,
+        _multiprocess,
+        _replicator,
+        _sync,
+    )
+    from poisson_tpu.parallel.multihost import is_primary
+    from poisson_tpu.solvers.checkpoint import (
+        _fingerprint,
+        load_state,
+        run_chunked,
+    )
+    from poisson_tpu.solvers.pcg import PCGState, host_fields64
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    px = mesh.shape[X_AXIS]
+    py = mesh.shape[Y_AXIS]
+    spec = shard_spec(problem, px, py, bm)
+    cs, cw, g, rhs, sc2, _, colmask = _shard_canvases(
+        problem, px, py, spec, "float32"
+    )
+    stacked_sp = P((X_AXIS, Y_AXIS))
+    if _multiprocess():
+        # Re-wrap the process-local canvases as global arrays (sc_int is
+        # not used on this path — solution unscaling is host-side).
+        wrap = lambda c, sp: _global_array(np.asarray(c), mesh, sp)
+        cs, cw, g, rhs, sc2 = (
+            wrap(c, stacked_sp) for c in (cs, cw, g, rhs, sc2)
+        )
+        colmask = wrap(colmask, P())
+    fp = _fingerprint(problem, "float32", True)
+
+    def stacked_state(full_state) -> _CkptState:
+        d = np.asarray(full_state.p, np.float32)
+        r = np.asarray(full_state.r, np.float32)
+        as_global = lambda host: (
+            _global_array(host, mesh, stacked_sp)
+            if _multiprocess() else jnp.asarray(host)
+        )
+        scalar = lambda x, dt: (
+            _global_array(np.asarray(x, dt), mesh, P())
+            if _multiprocess() else jnp.asarray(np.asarray(x, dt))
+        )
+        return _CkptState(
+            w=as_global(_scatter_canvases(problem, spec, px, py, full_state.w)),
+            r=as_global(_scatter_canvases(problem, spec, px, py, r)),
+            p=as_global(_scatter_canvases(problem, spec, px, py, d - r)),
+            k=scalar(full_state.k, np.int32),
+            done=scalar(full_state.done, bool),
+            zr=scalar(full_state.zr, np.float32),
+            beta=scalar(1.0, np.float32),      # β := 1 with p := d − r
+            diff=scalar(full_state.diff, np.float32),
+        )
+
+    saved = load_state(checkpoint_path, fp)
+    if saved is None:
+        state = _CkptState(*_init_stacked(problem, mesh, spec, rhs, colmask))
+    else:
+        state = stacked_state(saved)
+
+    def fetch(x):
+        return _replicator(mesh)(x) if _multiprocess() else x
+
+    def gather(x):
+        return _gather_full(problem, spec, px, py, fetch(x))
+
+    def to_portable(s: _CkptState) -> PCGState:
+        r_full = gather(s.r)
+        d_full = r_full + float(s.beta) * gather(s.p)
+        return PCGState(
+            k=np.asarray(s.k), done=np.asarray(s.done),
+            w=gather(s.w), r=r_full, z=r_full, p=d_full,
+            zr=np.asarray(s.zr), diff=np.asarray(s.diff),
+        )
+
+    state = run_chunked(
+        state,
+        advance=lambda s: _CkptState(*_chunk_solve(
+            problem, mesh, spec, interpret, chunk,
+            cs, cw, g, sc2, colmask,
+            s.w, s.r, s.p, s.k, s.done, s.zr, s.beta, s.diff,
+        )),
+        to_portable=to_portable,
+        path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
+        keep_checkpoint=keep_checkpoint, primary=is_primary, sync=_sync,
+    )
+
+    # Solution: gather owned w interiors and unscale with sc on the host
+    # (value-identical to pallas_cg_solve_sharded's per-shard w·sc_int:
+    # same fp32 operands, elementwise).
+    _, _, _, aux64 = host_fields64(problem, True)
+    w_y = gather(state.w)
+    w = w_y * np.asarray(aux64, w_y.dtype)
+    return PCGResult(w=jnp.asarray(w), iterations=state.k, diff=state.diff,
+                     residual_dot=state.zr)
+

@@ -76,6 +76,35 @@ def _run_chunk(problem: Problem, scaled: bool, chunk: int,
     return lax.while_loop(cond, body, state)
 
 
+def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
+                cap: int, keep_checkpoint: bool, primary=None, sync=None):
+    """The one chunked-checkpoint driver loop, shared by all four
+    checkpointed solvers (single/sharded × XLA/fused): advance until done
+    or cap, persist the portable full-grid state after every chunk, clean
+    up a *converged* run's checkpoint (a cap-hit keeps it for resume).
+
+    ``state`` must expose ``.done`` and ``.k``; ``advance(state)`` runs one
+    chunk; ``to_portable(state)`` produces the PCGState ``save_state``
+    writes. ``primary``/``sync`` gate the file write to one process and
+    barrier-order it against other processes' later reads (multi-process
+    meshes); they default to single-process no-ops.
+    """
+    primary = primary if primary is not None else (lambda: True)
+    sync = sync if sync is not None else (lambda name: None)
+    while (not bool(state.done)) and int(state.k) < cap:
+        state = advance(state)
+        jax.block_until_ready(state)
+        portable = to_portable(state)   # collective when multi-process
+        if primary():
+            save_state(path, portable, fingerprint)
+        sync("poisson_ckpt_save")       # write lands before anyone reads it
+    if bool(state.done) and not keep_checkpoint and primary() \
+            and os.path.exists(path):
+        os.remove(path)
+    sync("poisson_ckpt_done")           # removal precedes any follow-up solve
+    return state
+
+
 def save_state(path: str, state: PCGState, fingerprint: str) -> None:
     arrays = {key: np.asarray(val) for key, val in zip(_STATE_KEYS, state)}
     # np.savez appends '.npz' to names without it — keep the temp name
@@ -129,16 +158,13 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     if state is None:
         state = init_state(ops, rhs)
 
-    while (not bool(state.done)) and int(state.k) < problem.iteration_cap:
-        state = _run_chunk(problem, use_scaled, chunk, a, b, aux, state)
-        jax.block_until_ready(state)
-        save_state(checkpoint_path, state, fp)
-
-    # Clean up only a *converged* run's checkpoint; hitting the iteration
-    # cap unconverged keeps it so a rerun with a larger budget resumes.
-    converged = bool(state.done)
-    if converged and not keep_checkpoint and os.path.exists(checkpoint_path):
-        os.remove(checkpoint_path)
+    state = run_chunked(
+        state,
+        advance=lambda s: _run_chunk(problem, use_scaled, chunk, a, b, aux, s),
+        to_portable=lambda s: s,
+        path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
+        keep_checkpoint=keep_checkpoint,
+    )
 
     w = state.w * aux if use_scaled else state.w
     return PCGResult(

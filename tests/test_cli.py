@@ -64,9 +64,9 @@ def test_sharded_checkpoint_cli(capsys, tmp_path):
     assert rec["mesh"] == [2, 4]
 
 
-def test_checkpoint_rejects_fused_backends():
+def test_checkpoint_misuse_rejected():
     with pytest.raises(SystemExit):
-        main(["40", "40", "--backend", "pallas", "--checkpoint", "/tmp/x.npz"])
+        main(["40", "40", "--backend", "native", "--checkpoint", "/tmp/x.npz"])
     with pytest.raises(SystemExit):
         main(["40", "40", "--backend", "sharded", "--setup", "device",
               "--checkpoint", "/tmp/x.npz"])
@@ -74,3 +74,10 @@ def test_checkpoint_rejects_fused_backends():
     with pytest.raises(SystemExit):
         main(["40", "40", "--backend", "xla", "--mesh", "2x4",
               "--checkpoint", "/tmp/x.npz"])
+
+
+def test_pallas_checkpoint_cli(capsys, tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    assert main(["40", "40", "--backend", "pallas", "--checkpoint", ck,
+                 "--chunk", "10", "--json"]) == 0
+    assert _json_line(capsys)["iterations"] == 50

@@ -72,6 +72,20 @@ assert int(resumed.iterations) == 50, int(resumed.iterations)
 assert float(resumed.diff) < 1e-6
 if is_primary():
     assert not os.path.exists(ck)   # converged -> primary cleaned up
+
+# Fused (Pallas, interpret-mode) sharded checkpoint across the process
+# boundary: global canvas wraps, replicated gathers, same file handoff.
+from poisson_tpu.parallel import pallas_cg_solve_sharded_checkpointed
+
+ck2 = ck + ".fused"
+partial = pallas_cg_solve_sharded_checkpointed(
+    p40.with_(max_iter=20), mesh, ck2, chunk=10
+)
+assert int(partial.iterations) == 20, int(partial.iterations)
+assert os.path.exists(ck2)
+resumed = pallas_cg_solve_sharded_checkpointed(p40, mesh, ck2, chunk=10)
+assert int(resumed.iterations) == 50, int(resumed.iterations)
+assert float(resumed.diff) < 1e-6
 print(f"RANK{rank}_OK", flush=True)
 """
 
