@@ -52,6 +52,43 @@ def test_dryrun_multichip_self_hosting_from_polluted_env(tmp_path):
 
 
 @pytest.mark.slow
+def test_dryrun_gate_survives_config_poisoning_hook(tmp_path):
+    """The round-2 driver trap, reproduced on the side that actually broke:
+    the ENV says exactly what the driver sets (JAX_PLATFORMS=cpu + an
+    8-device forced host count), but a sitecustomize hook has already
+    rewritten ``jax.config.jax_platforms`` at interpreter startup — and
+    config beats env, so any parent-side ``jax.devices()`` would initialize
+    the bogus platform and die (for the real plugin: hang on a wedged
+    tunnel). The gate must re-exec a hermetic child with the hook directory
+    scrubbed and the config re-pinned, without ever touching the JAX
+    runtime in the parent."""
+    decoy = tmp_path / "plugin_site"
+    decoy.mkdir()
+    (decoy / "sitecustomize.py").write_text(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'bogus_remote_accel')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = f"{_ROOT}{os.pathsep}{decoy}"
+    code = (
+        "import jax, __graft_entry__ as g;"
+        # Prove the poison took effect in the parent (the real hook does
+        # this; an env-only test would pass even with the round-2 bug).
+        "assert jax.config.jax_platforms == 'bogus_remote_accel';"
+        "g.dryrun_multichip(8);"
+        "print('OUTER_OK')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=_ROOT, env=env,
+        capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "OUTER_OK" in proc.stdout
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("n", [16, 32])
 def test_dryrun_multichip_scales(n):
     env = dict(os.environ)
