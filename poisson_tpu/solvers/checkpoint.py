@@ -94,6 +94,12 @@ def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
     while (not bool(state.done)) and int(state.k) < cap:
         state = advance(state)
         jax.block_until_ready(state)
+        if bool(state.done) and not keep_checkpoint:
+            # The chunk just converged and the file would be deleted below:
+            # skip the full-grid gather (an all-gather collective on
+            # multi-process meshes) and the disk write outright. ``done`` is
+            # replicated, so every process skips in step.
+            break
         portable = to_portable(state)   # collective when multi-process
         if primary():
             save_state(path, portable, fingerprint)

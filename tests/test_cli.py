@@ -5,6 +5,7 @@ import json
 import pytest
 
 from poisson_tpu.cli import build_parser, main
+from poisson_tpu.config import Problem
 
 
 def _json_line(capsys) -> dict:
@@ -74,6 +75,47 @@ def test_checkpoint_misuse_rejected():
     with pytest.raises(SystemExit):
         main(["40", "40", "--backend", "xla", "--mesh", "2x4",
               "--checkpoint", "/tmp/x.npz"])
+    # auto + explicit --mesh + --setup device + --checkpoint must also
+    # error: the single-device fallback would silently drop the mesh.
+    with pytest.raises(SystemExit):
+        main(["40", "40", "--mesh", "2x4", "--setup", "device",
+              "--checkpoint", "/tmp/x.npz"])
+
+
+def test_auto_backend_device_setup_checkpoint_falls_back(capsys, tmp_path):
+    """auto + --setup device + --checkpoint on a multi-device host must not
+    error (it predates the sharded auto-pick): it falls back to the
+    single-device xla checkpointed path. Only the explicit
+    ``--backend sharded`` spelling earns the SystemExit."""
+    ck = str(tmp_path / "ck.npz")
+    assert main(["40", "40", "--setup", "device", "--checkpoint", ck,
+                 "--chunk", "10", "--json"]) == 0
+    rec = _json_line(capsys)
+    assert rec["iterations"] == 50
+    # Single-device xla path: no mesh, one device.
+    assert rec["mesh"] is None
+    assert rec["devices"] == 1
+
+
+def test_converged_solve_skips_final_checkpoint_write(tmp_path, monkeypatch):
+    """The final converging chunk's state would be deleted immediately —
+    run_chunked must not gather + write it (a wasted collective and disk
+    write on every converged solve at pod scale)."""
+    import poisson_tpu.solvers.checkpoint as ckpt
+
+    writes = []
+    real_save = ckpt.save_state
+    monkeypatch.setattr(
+        ckpt, "save_state",
+        lambda path, state, fp: (writes.append(int(state.k)),
+                                 real_save(path, state, fp)),
+    )
+    p = Problem(M=40, N=40)
+    got = ckpt.pcg_solve_checkpointed(p, str(tmp_path / "ck.npz"), chunk=7)
+    assert int(got.iterations) == 50
+    # Chunks end at 7,14,...,49; the converging chunk (50) is never saved.
+    assert writes and max(writes) < 50
+    assert not (tmp_path / "ck.npz").exists()
 
 
 def test_pallas_checkpoint_cli(capsys, tmp_path):
