@@ -25,9 +25,14 @@ favors breadth over per-row methodology.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from typing import Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from poisson_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
 
 # Best published reference time per grid: (config, seconds, iterations).
 # Sources: BASELINE.md (Этап1-4 PDFs' tables).
@@ -124,6 +129,7 @@ def _timed(run, fence, repeat: int):
 def main(argv=None) -> int:
     args = _parse_args(argv)
 
+    honor_jax_platforms_env()
     import jax
 
     from poisson_tpu.analysis import l2_error_host as l2

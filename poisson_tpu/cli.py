@@ -32,6 +32,7 @@ from typing import Optional
 import numpy as np
 
 from poisson_tpu.config import Problem
+from poisson_tpu.utils.platform import honor_jax_platforms_env
 from poisson_tpu.utils.timing import PhaseTimer, fence, solve_report
 
 
@@ -319,17 +320,10 @@ def _categories_table(problem: Problem, dtype, iters: int) -> list[str]:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    # An explicitly-set JAX_PLATFORMS must win even on machines whose
-    # sitecustomize hooks rewrite jax.config.jax_platforms at interpreter
-    # startup (config beats env in JAX, so the env alone is not enough —
-    # the round-2 driver post-mortem). Re-assert the user's choice before
-    # any backend can initialize; after parse_args so --help and argv
-    # errors stay jax-import-free.
-    platforms = os.environ.get("JAX_PLATFORMS")
-    if platforms:
-        import jax
-
-        jax.config.update("jax_platforms", platforms)
+    # After parse_args so --help and argv errors stay jax-import-free; see
+    # utils.platform for why the env var needs re-asserting (config beats
+    # env — the round-2 driver post-mortem).
+    honor_jax_platforms_env()
     problem = _problem(args)
     if args.categories and args.json:
         raise SystemExit("--categories produces a table; drop --json")

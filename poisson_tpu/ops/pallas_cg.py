@@ -375,9 +375,25 @@ def _colmask_spec(cv: Canvas):
     return pl.BlockSpec((1, cv.cols), lambda i: (0, 0))
 
 
+def _grid_params(parallel: bool):
+    """Strip-dimension semantics. ``parallel`` lets Mosaic distribute the
+    strip loop across TensorCores (megacore): every strip writes disjoint
+    center blocks and its own partial-output row, so the grid is
+    parallel-safe by construction. Off by default — it must earn its place
+    on hardware (BENCH.md) before becoming the default."""
+    if not parallel:
+        return {}
+    return {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel",)
+        )
+    }
+
+
 def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
                           interpret: bool,
-                          band: tuple[int, int] | None = None, colmask=None):
+                          band: tuple[int, int] | None = None, colmask=None,
+                          parallel: bool = False):
     """p_new, Ap, per-strip ⟨Ap, p_new⟩ partials ((nb, 1), unweighted; caller
     tree-sums) — one HBM sweep.
 
@@ -409,11 +425,12 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
             jax.ShapeDtypeStruct((cv.nb, 1), jnp.float32),
         ],
         interpret=interpret,
+        **_grid_params(parallel),
     )(*operands)
 
 
 def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
-                 colmask=None):
+                 colmask=None, parallel: bool = False):
     """w', r', per-strip Σ p²·sc² and Σ r'² partials ((nb, 1) each; caller
     tree-sums) — one HBM sweep."""
     masked = colmask is not None
@@ -448,6 +465,7 @@ def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
         ],
         input_output_aliases={w_idx: 0, w_idx + 1: 1},  # w → w', r → r'
         interpret=interpret,
+        **_grid_params(parallel),
     )(*operands)
 
 
@@ -463,7 +481,7 @@ class _FusedState(NamedTuple):
 
 
 def _make_fused_body(problem: Problem, cv: Canvas, interpret: bool,
-                     cs, cw, g, sc2, dtype):
+                     cs, cw, g, sc2, dtype, parallel: bool = False):
     """One fused iteration (kernels A + B) as a pure state→state function —
     shared by the convergence while_loop and the chunked checkpointed
     solve."""
@@ -473,14 +491,16 @@ def _make_fused_body(problem: Problem, cv: Canvas, interpret: bool,
     def body(s: _FusedState) -> _FusedState:
         beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
         pn, ap, denom_part = direction_and_stencil(
-            cv, beta, s.r, s.p, cs, cw, g, interpret=interpret
+            cv, beta, s.r, s.p, cs, cw, g, interpret=interpret,
+            parallel=parallel,
         )
         denom = jnp.sum(denom_part) * h1h2
         degenerate = jnp.abs(denom) < _DENOM_TOL
         alpha32 = jnp.where(degenerate, 0.0, s.zr / jnp.where(degenerate, 1.0, denom))
         alpha = jnp.reshape(alpha32, (1, 1)).astype(dtype)
         w, r, diff_part, zr_part = fused_update(
-            cv, alpha, pn, ap, sc2, s.w, s.r, interpret=interpret
+            cv, alpha, pn, ap, sc2, s.w, s.r, interpret=interpret,
+            parallel=parallel,
         )
         diff = jnp.abs(alpha32) * jnp.sqrt(jnp.sum(diff_part) * norm_w)
         zr_new = jnp.sum(zr_part) * h1h2
@@ -511,11 +531,12 @@ def _fused_init(cv: Canvas, rhs) -> _FusedState:
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
-                 cs, cw, g, rhs, sc2):
+                 parallel: bool, cs, cw, g, rhs, sc2):
     dtype = rhs.dtype
-    body = _make_fused_body(problem, cv, interpret, cs, cw, g, sc2, dtype)
+    body = _make_fused_body(problem, cv, interpret, cs, cw, g, sc2, dtype,
+                            parallel)
 
     def cond(s: _FusedState):
         return (~s.done) & (s.k < problem.iteration_cap)
@@ -527,7 +548,8 @@ def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
 
 def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
                         interpret: bool | None = None,
-                        dtype_name: str = "float32"):
+                        dtype_name: str = "float32",
+                        parallel: bool = False):
     """Fused solve of ``A w = rhs`` for a caller-supplied RHS grid
     (fp64 host array, full (M+1, N+1) shape) — the hook mixed-precision
     refinement (``solvers.refine``) drives. Coefficient canvases come from
@@ -544,7 +566,7 @@ def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
     rhs_canvas = np.zeros((cv.rows, cv.cols), np.float64)
     rhs_canvas[HALO : HALO + M - 1, : N + 1] = scaled[1:M, :]
     rhs = jnp.asarray(rhs_canvas, jnp.dtype(dtype_name))
-    s = _fused_solve(problem, cv, interpret, cs, cw, g, rhs, sc2)
+    s = _fused_solve(problem, cv, interpret, parallel, cs, cw, g, rhs, sc2)
     y = s.w[HALO : HALO + M - 1, 1:N]
     w64 = np.zeros(problem.grid_shape, np.float64)
     w64[1:M, 1:N] = np.asarray(y, np.float64) * np.asarray(
@@ -556,7 +578,7 @@ def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
 def pallas_cg_solve(problem: Problem, bm: int | None = None,
                     interpret: bool | None = None,
                     dtype_name: str = "float32",
-                    rhs_gate=None) -> PCGResult:
+                    rhs_gate=None, parallel: bool = False) -> PCGResult:
     """Single-device solve on the fused Pallas path (fp32, scaled system).
 
     A/B counterpart of ``solvers.pcg.pcg_solve(dtype=float32)`` — same
@@ -565,13 +587,15 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
     run (and are tested) on CPU. ``rhs_gate``, if given, is a traced scalar
     the RHS is multiplied by — pass exactly 1.0 to chain benchmark solves
     with a data dependency (serialized, bit-identical result).
+    ``parallel`` marks the strip grid parallel so Mosaic may split it
+    across TensorCores (megacore chips) — see :func:`_grid_params`.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     cv, cs, cw, g, rhs, sc2, sc_int = build_canvases(problem, bm, dtype_name)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
-    s = _fused_solve(problem, cv, interpret, cs, cw, g, rhs, sc2)
+    s = _fused_solve(problem, cv, interpret, parallel, cs, cw, g, rhs, sc2)
     # Canvas → full-grid solution, unscaled: w = sc · y.
     M, N = problem.M, problem.N
     y = s.w[HALO : HALO + M - 1, 1:N]

@@ -1,0 +1,24 @@
+"""Platform-selection hardening shared by every entry point.
+
+JAX resolves the platform from ``jax.config.jax_platforms`` first and the
+``JAX_PLATFORMS`` env var second — so a ``sitecustomize`` startup hook that
+rewrites the config (remote-accelerator PJRT plugins do) silently overrides
+the user's env var, and the first ``jax.devices()`` can then hang on an
+unreachable remote backend the user explicitly opted out of. Every CLI/
+benchmark entry point calls :func:`honor_jax_platforms_env` before its
+first device touch to make the env var authoritative again.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    """Re-assert an explicitly-set ``JAX_PLATFORMS`` into ``jax.config``
+    (config beats env; see module docstring). No-op when the var is unset."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)

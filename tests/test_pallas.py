@@ -102,11 +102,24 @@ def test_degenerate_direction_stops_cleanly():
     after one iteration with w=0, not NaN."""
     p = Problem(M=16, N=16, max_iter=5)
     cv, cs, cw, g, rhs, sc2, sc64 = build_canvases(p, 8)
-    s = pallas_cg._fused_solve(p, cv, True, cs, cw, g, jnp.zeros_like(rhs), sc2)
+    s = pallas_cg._fused_solve(
+        p, cv, True, False, cs, cw, g, jnp.zeros_like(rhs), sc2
+    )
     assert int(s.k) == 1
     assert bool(s.done)
     assert np.isfinite(np.asarray(s.w)).all()
     assert (np.asarray(s.w) == 0).all()
+
+
+def test_parallel_grid_matches_sequential():
+    """The parallel strip-grid option must be a pure scheduling hint: same
+    iterate sequence, bit-identical solution (per-strip partials are
+    tree-summed the same way either way)."""
+    p = Problem(M=40, N=40)
+    r_seq = pallas_cg_solve(p)
+    r_par = pallas_cg_solve(p, parallel=True)
+    assert int(r_par.iterations) == int(r_seq.iterations) == 50
+    np.testing.assert_array_equal(np.asarray(r_par.w), np.asarray(r_seq.w))
 
 
 def test_gate_is_bit_exact():
