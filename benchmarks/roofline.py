@@ -48,7 +48,10 @@ from poisson_tpu.utils.platform import honor_jax_platforms_env  # noqa: E402
 
 def _stream_gbps(jnp, jax, n_elems: int, reps: int = 5) -> float:
     """Best achieved GB/s for a 1-read + 1-write elementwise pass over
-    ``n_elems`` fp32 elements, overlap-proof and latency-differenced."""
+    ``n_elems`` fp32 elements, overlap-proof and latency-differenced.
+    Returns 0.0 when the differenced time is within timer noise (array too
+    small to measure) — callers treat 0 as 'no stream ceiling available'."""
+    n_elems = max(n_elems, 8 * 2**20)  # ≥32 MB: keep the slope above noise
     x = jnp.ones((n_elems,), jnp.float32)
 
     @jax.jit
@@ -69,12 +72,15 @@ def _stream_gbps(jnp, jax, n_elems: int, reps: int = 5) -> float:
     t_lo = min(chain(k_lo) for _ in range(reps))
     t_hi = min(chain(k_hi) for _ in range(reps))
     per_pass = (t_hi - t_lo) / (k_hi - k_lo)
+    if per_pass <= 0:
+        return 0.0
     return (n_elems * 4 * 2) / per_pass / 1e9
 
 
 def _solver_iter_seconds(problem, bm: int | None, iters: int,
                          interpret: bool,
-                         parallel: bool = False) -> tuple[float, dict]:
+                         parallel: bool = False,
+                         bn: int | None = None) -> tuple[float, dict]:
     """Wall seconds per fused-solve iteration at a fixed iteration budget
     (delta set below any reachable diff, so exactly ``iters`` iterations
     run), differenced between two budgets to cancel setup/fetch."""
@@ -86,7 +92,7 @@ def _solver_iter_seconds(problem, bm: int | None, iters: int,
         raise ValueError(f"need --iters >= 20 for a meaningful slope, got {iters}")
     lo = dataclasses.replace(problem, delta=1e-30, max_iter=iters // 4)
     hi = dataclasses.replace(problem, delta=1e-30, max_iter=iters)
-    cv, cs, cw, g, rhs, sc2, _ = build_canvases(hi, bm, "float32")
+    cv, cs, cw, g, rhs, sc2, _ = build_canvases(hi, bm, "float32", bn)
 
     def run(p):
         s = _fused_solve(p, cv, interpret, parallel, cs, cw, g, rhs, sc2)
@@ -108,10 +114,13 @@ def _solver_iter_seconds(problem, bm: int | None, iters: int,
     from poisson_tpu.ops.pallas_cg import HALO
 
     canvas_bytes = cv.rows * cv.cols * 4
-    overfetch = (cv.bm + 2 * HALO) / cv.bm
-    passes = (3 * overfetch + 2 + 2) + (5 + 2)
+    row_of = (cv.bm + 2 * HALO) / cv.bm
+    col_of = ((cv.bn + 2 * cv.cg) / cv.bn) if cv.cg else 1.0
+    # kernel A: z, p overfetch both ways; cs rows only; cw cols only.
+    passes = (2 * row_of * col_of + row_of + col_of + 1 + 2) + (5 + 2)
     geom = {
-        "bm": cv.bm, "nb": cv.nb, "canvas_rows": cv.rows,
+        "bm": cv.bm, "nb": cv.nb, "bn": cv.bn or None, "ncb": cv.ncb,
+        "canvas_rows": cv.rows,
         "canvas_cols": cv.cols, "canvas_mb": round(canvas_bytes / 2**20, 1),
         "model_passes": round(passes, 2),
         "model_bytes_per_iter_mb": round(passes * canvas_bytes / 2**20, 1),
@@ -129,6 +138,10 @@ def main() -> int:
     ap.add_argument("--parallel", action="store_true",
                     help="also measure each geometry with the strip grid "
                          "marked parallel (megacore TensorCore split)")
+    ap.add_argument("--bn", default=None,
+                    help="comma-separated column-block widths to add to the "
+                         "sweep (each paired with every --bm; 0 = full "
+                         "width)")
     args = ap.parse_args()
 
     honor_jax_platforms_env()
@@ -158,29 +171,34 @@ def main() -> int:
     report["stream_elems_mb"] = round(n_stream * 4 / 2**20, 1)
 
     bms = ([int(b) for b in args.bm.split(",")] if args.bm else [None])
+    bns = ([int(b) or None for b in args.bn.split(",")] if args.bn
+           else [None])
     rows = []
     for bm in bms:
-        for parallel in ([False, True] if args.parallel else [False]):
-            try:
-                per_iter, geom = _solver_iter_seconds(
-                    problem, bm, args.iters, interpret, parallel
+        for bn in bns:
+            for parallel in ([False, True] if args.parallel else [False]):
+                try:
+                    per_iter, geom = _solver_iter_seconds(
+                        problem, bm, args.iters, interpret, parallel, bn
+                    )
+                except Exception as e:
+                    rows.append({"bm": bm, "bn": bn, "parallel": parallel,
+                                 "error": repr(e)[:200]})
+                    continue
+                implied = (
+                    geom["model_bytes_per_iter_mb"] * 2**20 / per_iter / 1e9
                 )
-            except Exception as e:
-                rows.append({"bm": bm, "parallel": parallel,
-                             "error": repr(e)[:200]})
-                continue
-            implied = geom["model_bytes_per_iter_mb"] * 2**20 / per_iter / 1e9
-            mlups = (problem.M - 1) * (problem.N - 1) / per_iter / 1e6
-            rows.append({
-                **geom,
-                "parallel": parallel,
-                "iter_seconds": round(per_iter, 6),
-                "mlups": round(mlups, 1),
-                "implied_gbps": round(implied, 1),
-                "implied_over_stream": round(
-                    implied / report["stream_gbps"], 2
-                ) if report["stream_gbps"] else None,
-            })
+                mlups = (problem.M - 1) * (problem.N - 1) / per_iter / 1e6
+                rows.append({
+                    **geom,
+                    "parallel": parallel,
+                    "iter_seconds": round(per_iter, 6),
+                    "mlups": round(mlups, 1),
+                    "implied_gbps": round(implied, 1),
+                    "implied_over_stream": round(
+                        implied / report["stream_gbps"], 2
+                    ) if report["stream_gbps"] else None,
+                })
     report["grid"] = [args.M, args.N]
     report["solver"] = rows
     print(json.dumps(report))

@@ -1,0 +1,279 @@
+"""One-shot TPU evidence session: capture every hardware measurement the
+round needs the moment the tunnel is healthy.
+
+The tunneled chip has repeatedly been unreachable at snapshot time (two
+rounds of driver records), so hardware evidence must be captured whenever a
+window opens — all of it, in one resilient run:
+
+  1. device identity (device_kind, HBM stats)
+  2. flagship bench 800x1200 (refreshes BENCH_TPU_GOOD.json) + the two
+     larger published grids — golden iteration counts and L2 land in the
+     same JSON lines (re-validating the post-tree-sum kernels on hardware)
+  3. roofline sweep at 2400x3200 (strip heights x sequential/parallel
+     grid) and 1600x2400 — settles the large-grid plateau question
+  4. the masked sharded kernels Mosaic-compiled and run on a real chip
+     (1x1 mesh, 800x1200): golden count + L2 vs analytic
+  5. beyond-reference grids: 4800x4800 probe and the 16384x16384
+     north-star attempt (fixed-iteration MLUPS probe; allocation failures
+     are recorded with memory stats, not raised)
+  6. report artifacts: L2-vs-iteration curve CSV (+ PNG if matplotlib is
+     usable) and a cross-backend sweep table
+
+Every step runs as a subprocess with its own timeout; failures are
+recorded and the session moves on. Results land in ``benchmarks/results/``
+as JSON-lines (``session.jsonl``) plus the artifact files, ready to commit.
+
+Usage:  python benchmarks/tpu_session.py [--quick] [--outdir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(_ROOT))
+
+
+def _utc() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds"
+    )
+
+
+class Session:
+    def __init__(self, outdir: pathlib.Path):
+        self.outdir = outdir
+        outdir.mkdir(parents=True, exist_ok=True)
+        self.log = outdir / "session.jsonl"
+
+    def record(self, step: str, payload: dict) -> None:
+        entry = {"step": step, "at": _utc(), **payload}
+        with self.log.open("a") as f:
+            f.write(json.dumps(entry) + "\n")
+        print(f"[{step}] {json.dumps(payload)[:300]}", flush=True)
+
+    def run(self, step: str, argv: list[str], timeout: float,
+            parse_json_tail: bool = False) -> dict | None:
+        """Run a subprocess step; record rc/output; never raise."""
+        try:
+            proc = subprocess.run(
+                argv, cwd=_ROOT, env=dict(os.environ), text=True,
+                capture_output=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            self.record(step, {"ok": False, "error": f"timeout>{timeout:.0f}s"})
+            return None
+        out = proc.stdout.strip()
+        if proc.returncode != 0:
+            self.record(step, {
+                "ok": False, "rc": proc.returncode,
+                "stderr": proc.stderr[-1500:], "stdout": out[-500:],
+            })
+            return None
+        payload: dict = {"ok": True}
+        parsed = None
+        if parse_json_tail and out:
+            for line in reversed(out.splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        parsed = json.loads(line)
+                        break
+                    except ValueError:
+                        continue
+            payload["result"] = parsed
+        else:
+            payload["stdout"] = out[-2000:]
+        self.record(step, payload)
+        return parsed if parse_json_tail else payload
+
+
+_SHARDED_1X1 = r"""
+import json
+from poisson_tpu.utils.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+import numpy as np
+from poisson_tpu.config import Problem
+from poisson_tpu.parallel import make_solver_mesh
+from poisson_tpu.parallel.pallas_sharded import pallas_cg_solve_sharded
+from poisson_tpu.analysis import l2_error_host
+from poisson_tpu.utils.timing import fence, mlups
+import time
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev.platform
+mesh = make_solver_mesh(jax.devices()[:1], grid=(1, 1))
+problem = Problem(M=800, N=1200)
+t0 = time.perf_counter()
+res = pallas_cg_solve_sharded(problem, mesh, interpret=False)
+fence(res.iterations)
+first = time.perf_counter() - t0
+t0 = time.perf_counter()
+res = pallas_cg_solve_sharded(problem, mesh, interpret=False)
+fence(res.iterations)
+solve = time.perf_counter() - t0
+print(json.dumps({
+    "backend": "pallas_sharded(masked, Mosaic)", "mesh": [1, 1],
+    "grid": [800, 1200], "iterations": int(res.iterations),
+    "golden": 989, "l2_error": l2_error_host(problem, res.w),
+    "compile_and_first_s": round(first, 2),
+    "solve_s": round(solve, 4),
+    "mlups": round(mlups(problem, int(res.iterations), solve), 1),
+    "device_kind": dev.device_kind,
+}))
+"""
+
+_BIG_GRID = r"""
+import json, sys, time, dataclasses
+from poisson_tpu.utils.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+import jax.numpy as jnp
+from poisson_tpu.config import Problem
+from poisson_tpu.ops.pallas_cg import build_canvases, _fused_solve
+
+M, N, iters = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+bn = int(sys.argv[4]) if len(sys.argv) > 4 and int(sys.argv[4]) else None
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev.platform
+out = {"grid": [M, N], "bn": bn, "device_kind": dev.device_kind}
+try:
+    problem = Problem(M=M, N=N, delta=1e-30, max_iter=iters)
+    cv, cs, cw, g, rhs, sc2, _ = build_canvases(problem, None, "float32", bn)
+    canvases_gb = 8 * cv.rows * cv.cols * 4 / 2**30
+    out.update(bm=cv.bm, nb=cv.nb, canvas_rows=cv.rows, canvas_cols=cv.cols,
+               working_set_gb=round(canvases_gb, 2))
+    lo = dataclasses.replace(problem, max_iter=max(5, iters // 4))
+    s = _fused_solve(lo, cv, False, False, cs, cw, g, rhs, sc2)
+    s.diff.block_until_ready()
+    t0 = time.perf_counter()
+    s = _fused_solve(lo, cv, False, False, cs, cw, g, rhs, sc2)
+    s.diff.block_until_ready()
+    t_lo = time.perf_counter() - t0
+    s = _fused_solve(problem, cv, False, False, cs, cw, g, rhs, sc2)
+    s.diff.block_until_ready()
+    t0 = time.perf_counter()
+    s = _fused_solve(problem, cv, False, False, cs, cw, g, rhs, sc2)
+    s.diff.block_until_ready()
+    t_hi = time.perf_counter() - t0
+    per_iter = (t_hi - t_lo) / (problem.max_iter - lo.max_iter)
+    out.update(ok=True, iter_seconds=round(per_iter, 6),
+               mlups=round((M - 1) * (N - 1) / per_iter / 1e6, 1),
+               probe_iters=iters)
+except Exception as e:
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    out.update(ok=False, error=repr(e)[:600],
+               hbm_limit_gb=round(stats.get("bytes_limit", 0) / 2**30, 1),
+               hbm_in_use_gb=round(stats.get("bytes_in_use", 0) / 2**30, 2))
+print(json.dumps(out))
+"""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--outdir", default=str(_ROOT / "benchmarks" / "results"))
+    ap.add_argument("--quick", action="store_true",
+                    help="flagship + sharded-1x1 + roofline only")
+    args = ap.parse_args()
+    s = Session(pathlib.Path(args.outdir))
+    py = sys.executable
+
+    # 1. identity — also the tunnel liveness gate for the whole session
+    ident = s.run("identity", [
+        py, "-c",
+        "import json\n"
+        "from poisson_tpu.utils.platform import honor_jax_platforms_env\n"
+        "honor_jax_platforms_env()\n"
+        "import jax\n"
+        "d = jax.devices()[0]\n"
+        "m = {}\n"
+        "try: m = d.memory_stats() or {}\n"
+        "except Exception: pass\n"
+        "print(json.dumps({'platform': d.platform, 'kind': d.device_kind, "
+        "'n': len(jax.devices()), "
+        "'hbm_gb': round(m.get('bytes_limit', 0) / 2**30, 1)}))",
+    ], timeout=150, parse_json_tail=True)
+    if not ident or ident.get("platform") != "tpu":
+        s.record("abort", {"reason": "tunnel not healthy; nothing captured"})
+        return 1
+
+    # 2. benches (flagship first: refreshes BENCH_TPU_GOOD.json)
+    for grid, to in (((800, 1200), 900), ((1600, 2400), 1200),
+                     ((2400, 3200), 1800)):
+        if args.quick and grid != (800, 1200):
+            continue
+        s.run(f"bench_{grid[0]}x{grid[1]}",
+              [py, "bench.py", str(grid[0]), str(grid[1])],
+              timeout=to, parse_json_tail=True)
+
+    # 3. roofline (full-width strip heights x parallel, plus the
+    # column-blocked geometry at its auto strip height)
+    s.run("roofline_2400x3200", [
+        py, "benchmarks/roofline.py", "2400", "3200",
+        "--bm", "48,72,96", "--iters", "200", "--parallel",
+    ], timeout=1800, parse_json_tail=True)
+    s.run("roofline_2400x3200_blocked", [
+        py, "benchmarks/roofline.py", "2400", "3200",
+        "--bn", "1024,2048", "--iters", "200", "--parallel",
+    ], timeout=1800, parse_json_tail=True)
+    if not args.quick:
+        s.run("roofline_1600x2400", [
+            py, "benchmarks/roofline.py", "1600", "2400",
+            "--bm", "64,128", "--iters", "200", "--parallel",
+        ], timeout=1200, parse_json_tail=True)
+
+    # 4. masked sharded kernels on the real chip (1x1 mesh)
+    s.run("sharded_1x1_mosaic", [py, "-c", _SHARDED_1X1],
+          timeout=1200, parse_json_tail=True)
+
+    # 5. beyond-reference grids (full-width and column-blocked geometries)
+    s.run("grid_4800x4800", [py, "-c", _BIG_GRID, "4800", "4800", "50"],
+          timeout=900, parse_json_tail=True)
+    s.run("grid_4800x4800_bn1024",
+          [py, "-c", _BIG_GRID, "4800", "4800", "50", "1024"],
+          timeout=900, parse_json_tail=True)
+    s.run("grid_16384x16384", [py, "-c", _BIG_GRID, "16384", "16384", "50"],
+          timeout=1500, parse_json_tail=True)
+    s.run("grid_16384x16384_bn2048",
+          [py, "-c", _BIG_GRID, "16384", "16384", "50", "2048"],
+          timeout=1500, parse_json_tail=True)
+
+    if not args.quick:
+        # 6. report artifacts
+        curve = str(s.outdir / "curve_800x1200_tpu.csv")
+        # sweep.py always emits its table too: pin it to one cheap row so
+        # the fragile TPU window is spent on the curve, not a duplicate
+        # sweep (the real table is the dedicated sweep_table step below).
+        got = s.run("curve_800x1200", [
+            py, "benchmarks/sweep.py", "--curve", "800x1200:989",
+            "--curve-out", curve, "--grids", "40x40",
+            "--backends", "xla", "--repeat", "1",
+        ], timeout=1200)
+        if got and got.get("ok"):
+            s.run("curve_png", [
+                py, "benchmarks/plot_curve.py", curve,
+                str(s.outdir / "curve_800x1200_tpu.png"),
+            ], timeout=300)
+        s.run("sweep_table", [
+            py, "benchmarks/sweep.py", "--grids",
+            "400x600,800x1200,1600x2400,2400x3200",
+            "--backends", "pallas,xla", "--repeat", "2",
+            "--out", str(s.outdir / "sweep_tpu.md"),
+        ], timeout=3600)
+
+    s.record("done", {"log": str(s.log)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

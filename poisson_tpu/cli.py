@@ -24,7 +24,6 @@ Instrumentation (stage4's ``MPI_Wtime`` bracketing + timer table, SURVEY §5):
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 import time
 from typing import Optional

@@ -111,23 +111,47 @@ def canvas_cols(problem: Problem) -> int:
 
 
 class Canvas(NamedTuple):
-    """Static geometry of the strip-aligned canvas."""
+    """Static geometry of the strip-aligned canvas.
+
+    Full-width (``cg == 0``): one strip per grid step spans every column —
+    the hardware-proven default. Column-blocked (``cg == LANE``): a 2D
+    kernel grid of (strip, column-block) tiles with LANE-wide column guard
+    bands mirroring the row guards; grid column j lives at canvas column
+    ``cg + j``. Blocking exists for canvases too wide for a sane strip
+    height (the VMEM budget divides by the buffer width, so a 16384-wide
+    grid forces 8-row strips whose halo overhead triples the stencil's
+    read traffic)."""
 
     bm: int     # strip height (interior rows per grid step)
     nb: int     # number of interior strips
     rows: int   # nb·bm + 2·HALO
-    cols: int   # N+1 padded to LANE
+    cols: int   # content cols padded to LANE, plus 2·cg when blocked
+    bn: int = 0     # column-block width (0 = full width)
+    ncb: int = 1    # number of column blocks
+    cg: int = 0     # column guard width (LANE when blocked)
 
 
-def canvas_spec(problem: Problem, bm: int | None = None) -> Canvas:
-    bm = bm if bm is not None else pick_bm(problem)
+def canvas_spec(problem: Problem, bm: int | None = None,
+                bn: int | None = None) -> Canvas:
+    if bn is not None:
+        if bn <= 0 or bn % LANE != 0:
+            # Lane-dimension block offsets must stay LANE-aligned.
+            raise ValueError(f"bn must be a positive multiple of {LANE}, got {bn}")
+        ncb = -(-(problem.N + 1) // bn)
+        cols = 2 * LANE + ncb * bn
+        if bm is None:
+            bm = strip_height(bn + 2 * LANE, problem.M - 1)
+    else:
+        ncb, cols = 1, canvas_cols(problem)
+        if bm is None:
+            bm = pick_bm(problem)
     if bm <= 0 or bm % SUBLANE != 0:
         # The strip/block index maps multiply in SUBLANE granules; any other
         # bm would silently address the wrong rows.
         raise ValueError(f"bm must be a positive multiple of {SUBLANE}, got {bm}")
     nb = -(-(problem.M - 1) // bm)
-    return Canvas(bm=bm, nb=nb, rows=nb * bm + 2 * HALO,
-                  cols=canvas_cols(problem))
+    return Canvas(bm=bm, nb=nb, rows=nb * bm + 2 * HALO, cols=cols,
+                  bn=(bn or 0), ncb=ncb, cg=(LANE if bn else 0))
 
 
 def scaled_stencil_fields(problem: Problem):
@@ -151,7 +175,7 @@ def scaled_stencil_fields(problem: Problem):
 
 @functools.lru_cache(maxsize=8)
 def build_canvases(problem: Problem, bm: int | None = None,
-                   dtype_name: str = "float32"):
+                   dtype_name: str = "float32", bn: int | None = None):
     """Host fp64 setup → canvas-laid-out device arrays.
 
     Reuses :func:`solvers.pcg.host_fields64` (the shared precision-policy
@@ -169,16 +193,19 @@ def build_canvases(problem: Problem, bm: int | None = None,
     extraction. ``g`` is the diagonal residual (see
     :func:`diagonal_residual_canvas`).
     """
-    cv = canvas_spec(problem, bm)
+    cv = canvas_spec(problem, bm, bn)
     dtype = jnp.dtype(dtype_name)
     M, N = problem.M, problem.N
     gcs, gcw, sc2_64, rhs64, sc64 = scaled_stencil_fields(problem)
 
     def to_canvas(grid_rows_1_to_M: np.ndarray, col0: int = 0) -> np.ndarray:
-        """Embed rows 1..M(−1) of a full (M+1,N+1) grid at canvas row HALO+…"""
+        """Embed rows 1..M(−1) of a full (M+1,N+1) grid at canvas row HALO+…
+        and canvas column cg+col0 (cg = 0 on the full-width layout)."""
         out = np.zeros((cv.rows, cv.cols), np.float64)
         nr, nc = grid_rows_1_to_M.shape
-        out[HALO : HALO + nr, col0 : col0 + nc] = grid_rows_1_to_M
+        out[HALO : HALO + nr, cv.cg + col0 : cv.cg + col0 + nc] = (
+            grid_rows_1_to_M
+        )
         return out
 
     # Edge coefficients for i = 1..M (row i=M closes the last interior
@@ -305,6 +332,54 @@ def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
     return kernel
 
 
+def _make_blocked_stencil_kernel(cv: Canvas, band: tuple[int, int]):
+    """Column-blocked kernel A (single-device layouts only): the full-width
+    kernel's math on a (strip, column-block) 2D grid. Column guards play
+    the role row guards play in the full-width layout — every ±1-column
+    stencil read comes from the widened block instead of an in-register
+    zero shift — and the fresh direction buffer's unwritten guard regions
+    are zeroed through the same in-band mask, extended to columns."""
+    h = HALO
+    cg = cv.cg
+    band_lo, band_hi = band
+
+    def kernel(beta_ref, z_ref, p_ref, cs_ref, cw_ref, g_ref,
+               pn_ref, ap_ref, denom_ref):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+        beta = beta_ref[0, 0]
+        rows = i * cv.bm + lax.broadcasted_iota(
+            jnp.int32, (cv.bm + 2 * h, 1), 0
+        )
+        cols = j * cv.bn + lax.broadcasted_iota(
+            jnp.int32, (1, cv.bn + 2 * cg), 1
+        )
+        live = (
+            (rows >= band_lo) & (rows < band_hi)
+            & (cols >= cg) & (cols < cg + cv.ncb * cv.bn)
+        )
+        pn = jnp.where(live, z_ref[:] + beta * p_ref[:], 0.0)
+        c = pn[h:-h, cg:-cg]                       # center rows & cols
+        cs_c = cs_ref[h:-h, :]
+        cs_n = cs_ref[h + 1 : -h + 1, :]
+        cw_c = cw_ref[:, cg:-cg]
+        cw_e = cw_ref[:, cg + 1 : -cg + 1]
+        ap = (
+            cs_n * (c - pn[h + 1 : -h + 1, cg:-cg])
+            + cs_c * (c - pn[h - 1 : -h - 1, cg:-cg])
+            + cw_e * (c - pn[h:-h, cg + 1 : -cg + 1])
+            + cw_c * (c - pn[h:-h, cg - 1 : -cg - 1])
+            + g_ref[:] * c
+        )
+        pn_ref[:] = c
+        ap_ref[:] = ap
+        # Per-tile partial (row i, col j of an (nb, ncb) output); the
+        # caller tree-sums, same accuracy rationale as the strip partials.
+        denom_ref[0, 0] = jnp.sum(ap * c, dtype=jnp.float32)
+
+    return kernel
+
+
 def _make_update_kernel(masked: bool):
     """Kernel B: w ← w + α·p, r ← r − α·Ap, accumulate Σp²·sc² and Σr².
 
@@ -370,22 +445,52 @@ def _canvas_shape(cv: Canvas, dtype):
     return jax.ShapeDtypeStruct((cv.rows, cv.cols), dtype)
 
 
+# --- column-blocked (2D-grid) spec family; offsets written as literal
+# SUBLANE/LANE multiplies for Mosaic's divisibility prover ------------------
+
+
+def _blk_specs(cv: Canvas):
+    granules = cv.bm // SUBLANE
+    lanes = cv.bn // LANE
+    strip = pl.BlockSpec(        # z, p: halo rows AND guard cols
+        (pl.Element(cv.bm + 2 * HALO), pl.Element(cv.bn + 2 * cv.cg)),
+        lambda i, j: (SUBLANE * (i * granules), LANE * (j * lanes)),
+    )
+    cs = pl.BlockSpec(           # halo rows, center cols
+        (pl.Element(cv.bm + 2 * HALO), pl.Element(cv.bn)),
+        lambda i, j: (SUBLANE * (i * granules), LANE * (j * lanes + 1)),
+    )
+    cw = pl.BlockSpec(           # center rows, guard cols (east shift)
+        (pl.Element(cv.bm), pl.Element(cv.bn + 2 * cv.cg)),
+        lambda i, j: (SUBLANE * (i * granules + 1), LANE * (j * lanes)),
+    )
+    block = pl.BlockSpec(        # center tile
+        (pl.Element(cv.bm), pl.Element(cv.bn)),
+        lambda i, j: (SUBLANE * (i * granules + 1), LANE * (j * lanes + 1)),
+    )
+    scalar = pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                          memory_space=pltpu.SMEM)
+    partial = pl.BlockSpec((1, 1), lambda i, j: (i, j),
+                           memory_space=pltpu.SMEM)
+    return strip, cs, cw, block, scalar, partial
+
+
 def _colmask_spec(cv: Canvas):
     """(1, C) row broadcast to every strip."""
     return pl.BlockSpec((1, cv.cols), lambda i: (0, 0))
 
 
-def _grid_params(parallel: bool):
-    """Strip-dimension semantics. ``parallel`` lets Mosaic distribute the
-    strip loop across TensorCores (megacore): every strip writes disjoint
-    center blocks and its own partial-output row, so the grid is
+def _grid_params(parallel: bool, ndims: int = 1):
+    """Grid-dimension semantics. ``parallel`` lets Mosaic distribute the
+    tile loop across TensorCores (megacore): every tile writes disjoint
+    center blocks and its own partial-output cell, so the grid is
     parallel-safe by construction. Off by default — it must earn its place
     on hardware (BENCH.md) before becoming the default."""
     if not parallel:
         return {}
     return {
         "compiler_params": pltpu.CompilerParams(
-            dimension_semantics=("parallel",)
+            dimension_semantics=("parallel",) * ndims
         )
     }
 
@@ -398,9 +503,27 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
     tree-sums) — one HBM sweep.
 
     ``band``/``colmask`` select the sharded variant (see the kernel factory);
-    defaults are the single-device interior band with no mask."""
+    defaults are the single-device interior band with no mask. A
+    column-blocked canvas (``cv.cg > 0``) routes to the 2D-grid kernel —
+    single-device only (the sharded layouts stay full-width)."""
     if band is None:
         band = (HALO, cv.rows - HALO)
+    if cv.cg:
+        assert colmask is None, "column blocking is single-device only"
+        strip, cs_spec, cw_spec, block, scalar, partial = _blk_specs(cv)
+        return pl.pallas_call(
+            _make_blocked_stencil_kernel(cv, band),
+            grid=(cv.nb, cv.ncb),
+            in_specs=[scalar, strip, strip, cs_spec, cw_spec, block],
+            out_specs=[block, block, partial],
+            out_shape=[
+                _canvas_shape(cv, p.dtype),
+                _canvas_shape(cv, p.dtype),
+                jax.ShapeDtypeStruct((cv.nb, cv.ncb), jnp.float32),
+            ],
+            interpret=interpret,
+            **_grid_params(parallel, 2),
+        )(beta, z, p, cs, cw, g)
     masked = colmask is not None
     in_specs = [
         _scalar_spec(),
@@ -432,7 +555,27 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
 def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
                  colmask=None, parallel: bool = False):
     """w', r', per-strip Σ p²·sc² and Σ r'² partials ((nb, 1) each; caller
-    tree-sums) — one HBM sweep."""
+    tree-sums) — one HBM sweep. Column-blocked canvases run the same
+    kernel body on the (strip, column-block) 2D grid with (nb, ncb)
+    partials."""
+    if cv.cg:
+        assert colmask is None, "column blocking is single-device only"
+        _, _, _, block, scalar, partial = _blk_specs(cv)
+        return pl.pallas_call(
+            _make_update_kernel(masked=False),
+            grid=(cv.nb, cv.ncb),
+            in_specs=[scalar, block, block, block, block, block],
+            out_specs=[block, block, partial, partial],
+            out_shape=[
+                _canvas_shape(cv, w.dtype),
+                _canvas_shape(cv, w.dtype),
+                jax.ShapeDtypeStruct((cv.nb, cv.ncb), jnp.float32),
+                jax.ShapeDtypeStruct((cv.nb, cv.ncb), jnp.float32),
+            ],
+            input_output_aliases={4: 0, 5: 1},  # w → w', r → r'
+            interpret=interpret,
+            **_grid_params(parallel, 2),
+        )(alpha, p, ap, sc2, w, r)
     masked = colmask is not None
     in_specs = [
         _scalar_spec(),
@@ -578,7 +721,8 @@ def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
 def pallas_cg_solve(problem: Problem, bm: int | None = None,
                     interpret: bool | None = None,
                     dtype_name: str = "float32",
-                    rhs_gate=None, parallel: bool = False) -> PCGResult:
+                    rhs_gate=None, parallel: bool = False,
+                    bn: int | None = None) -> PCGResult:
     """Single-device solve on the fused Pallas path (fp32, scaled system).
 
     A/B counterpart of ``solvers.pcg.pcg_solve(dtype=float32)`` — same
@@ -587,18 +731,22 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
     run (and are tested) on CPU. ``rhs_gate``, if given, is a traced scalar
     the RHS is multiplied by — pass exactly 1.0 to chain benchmark solves
     with a data dependency (serialized, bit-identical result).
-    ``parallel`` marks the strip grid parallel so Mosaic may split it
+    ``parallel`` marks the tile grid parallel so Mosaic may split it
     across TensorCores (megacore chips) — see :func:`_grid_params`.
+    ``bn`` selects the column-blocked canvas (see :class:`Canvas`), for
+    grids too wide for a sane full-width strip height.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    cv, cs, cw, g, rhs, sc2, sc_int = build_canvases(problem, bm, dtype_name)
+    cv, cs, cw, g, rhs, sc2, sc_int = build_canvases(
+        problem, bm, dtype_name, bn
+    )
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     s = _fused_solve(problem, cv, interpret, parallel, cs, cw, g, rhs, sc2)
     # Canvas → full-grid solution, unscaled: w = sc · y.
     M, N = problem.M, problem.N
-    y = s.w[HALO : HALO + M - 1, 1:N]
+    y = s.w[HALO : HALO + M - 1, cv.cg + 1 : cv.cg + N]
     w = jnp.pad(y * sc_int, 1)
     return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr)
 

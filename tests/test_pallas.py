@@ -111,6 +111,32 @@ def test_degenerate_direction_stops_cleanly():
     assert (np.asarray(s.w) == 0).all()
 
 
+@pytest.mark.parametrize(
+    "M,N,bm,bn",
+    [
+        (40, 40, 16, 128),    # ncb=1: guards exercised, single block
+        (40, 300, 16, 128),   # ncb=3: interior columns cross block seams
+        (80, 300, None, 256), # auto bm, uneven last block (301 into 2x256)
+    ],
+)
+def test_column_blocked_solve_parity(M, N, bm, bn):
+    """The column-blocked (2D-grid) canvas must reproduce the full-width
+    fused path: same iteration count, same solution to fp32 tolerance
+    (partial-sum tree shape differs, so bitwise equality is not expected)."""
+    p = Problem(M=M, N=N)
+    r_full = pallas_cg_solve(p)
+    r_blk = pallas_cg_solve(p, bm=bm, bn=bn)
+    assert int(r_blk.iterations) == int(r_full.iterations)
+    np.testing.assert_allclose(
+        np.asarray(r_blk.w), np.asarray(r_full.w), atol=1e-6
+    )
+
+
+def test_column_blocked_golden_40x40():
+    r = pallas_cg_solve(Problem(M=40, N=40), bm=16, bn=128)
+    assert int(r.iterations) == 50
+
+
 def test_parallel_grid_matches_sequential():
     """The parallel strip-grid option must be a pure scheduling hint: same
     iterate sequence, bit-identical solution (per-strip partials are
