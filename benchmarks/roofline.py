@@ -166,7 +166,8 @@ def main() -> int:
     # Stream array sized like the solve's state working set (4 canvases),
     # capped to stay comfortably allocatable alongside the solve.
     n_interior = (problem.M - 1) * (problem.N + 1)
-    n_stream = min(4 * n_interior, 512 * 2**20 // 4)
+    # Same clamps _stream_gbps applies, so the report matches what ran.
+    n_stream = max(min(4 * n_interior, 512 * 2**20 // 4), 8 * 2**20)
     report["stream_gbps"] = round(_stream_gbps(jnp, jax, n_stream), 1)
     report["stream_elems_mb"] = round(n_stream * 4 / 2**20, 1)
 

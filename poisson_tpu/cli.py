@@ -71,6 +71,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="state precision (default: float64 if x64 on, else float32)")
     p.add_argument("--threads", type=int, default=0,
                    help="OpenMP threads for --backend native (0 = runtime default)")
+    p.add_argument("--bm", type=int, default=None,
+                   help="pallas strip height (multiple of 8; default: "
+                        "VMEM-budget heuristic)")
+    p.add_argument("--bn", type=int, default=None,
+                   help="pallas column-block width (multiple of 128; "
+                        "default: full-width strips)")
+    p.add_argument("--parallel-grid", action="store_true",
+                   help="mark the pallas tile grid parallel (megacore "
+                        "TensorCore split; single-device pallas backend)")
     p.add_argument("--unweighted-norm", action="store_true",
                    help="stage0's unweighted convergence norm")
     p.add_argument("--repeat", type=int, default=1,
@@ -188,10 +197,13 @@ def _run_jax(args, problem: Problem, backend: str):
                 )
 
                 run = lambda: pallas_cg_solve_sharded_checkpointed(
-                    problem, mesh, args.checkpoint, chunk=args.chunk
+                    problem, mesh, args.checkpoint, chunk=args.chunk,
+                    bm=args.bm,
                 )
             else:
-                run = lambda: pallas_cg_solve_sharded(problem, mesh)
+                run = lambda: pallas_cg_solve_sharded(
+                    problem, mesh, bm=args.bm
+                )
         elif args.checkpoint:
             if args.setup == "device":
                 raise SystemExit(
@@ -216,15 +228,23 @@ def _run_jax(args, problem: Problem, backend: str):
                 "for float64"
             )
         if args.checkpoint:
+            if args.bn is not None:
+                raise SystemExit(
+                    "--bn is not supported with --checkpoint (the portable "
+                    "checkpoint layout is full-width)"
+                )
             from poisson_tpu.ops.pallas_cg import pallas_cg_solve_checkpointed
 
             run = lambda: pallas_cg_solve_checkpointed(
-                problem, args.checkpoint, chunk=args.chunk
+                problem, args.checkpoint, chunk=args.chunk, bm=args.bm
             )
         else:
             from poisson_tpu.ops.pallas_cg import pallas_cg_solve
 
-            run = lambda: pallas_cg_solve(problem)
+            run = lambda: pallas_cg_solve(
+                problem, bm=args.bm, bn=args.bn,
+                parallel=args.parallel_grid,
+            )
         n_dev = 1
     elif args.checkpoint:
         from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
@@ -348,9 +368,32 @@ def main(argv=None) -> int:
         if args.categories:
             raise SystemExit("--categories times the JAX ops; "
                              "not available with --backend native")
+        if args.bm is not None or args.bn is not None or args.parallel_grid:
+            raise SystemExit(
+                "--bm/--bn/--parallel-grid shape the pallas kernels; "
+                "not available with --backend native"
+            )
         report, timer, w = _run_native(args, problem)
     else:
         backend = _pick_backend(args)
+        # Geometry flags must reach a kernel, not be silently dropped.
+        if args.bn is not None and backend != "pallas":
+            raise SystemExit(
+                f"--bn applies to the single-device pallas backend "
+                f"(resolved backend: {backend})"
+            )
+        if args.parallel_grid and backend != "pallas":
+            raise SystemExit(
+                f"--parallel-grid applies to the single-device pallas "
+                f"backend (resolved backend: {backend})"
+            )
+        if args.bm is not None and backend not in (
+            "pallas", "pallas-sharded"
+        ):
+            raise SystemExit(
+                f"--bm applies to the pallas backends "
+                f"(resolved backend: {backend})"
+            )
         report, timer, w = _run_jax(args, problem, backend)
 
     if args.save_solution:

@@ -137,6 +137,14 @@ def test_column_blocked_golden_40x40():
     assert int(r.iterations) == 50
 
 
+@pytest.mark.slow
+def test_column_blocked_golden_400x600():
+    """Blocked path at a published grid with real multi-block seams
+    (601 content cols → 3 × bn=256): golden count exact."""
+    r = pallas_cg_solve(Problem(M=400, N=600), bn=256)
+    assert int(r.iterations) == 546
+
+
 def test_parallel_grid_matches_sequential():
     """The parallel strip-grid option must be a pure scheduling hint: same
     iterate sequence, bit-identical solution (per-strip partials are
