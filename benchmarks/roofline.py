@@ -172,8 +172,9 @@ def main() -> int:
     report["stream_elems_mb"] = round(n_stream * 4 / 2**20, 1)
 
     bms = ([int(b) for b in args.bm.split(",")] if args.bm else [None])
-    bns = ([int(b) or None for b in args.bn.split(",")] if args.bn
-           else [None])
+    # bn=0 is canvas_spec's force-full-width sentinel; None (no flag) is
+    # the shipping auto-pick.
+    bns = ([int(b) for b in args.bn.split(",")] if args.bn else [None])
     rows = []
     for bm in bms:
         for bn in bns:

@@ -140,10 +140,12 @@ from poisson_tpu.config import Problem
 from poisson_tpu.ops.pallas_cg import build_canvases, _fused_solve
 
 M, N, iters = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
-bn = int(sys.argv[4]) if len(sys.argv) > 4 and int(sys.argv[4]) else None
+# argv bn: 0 (or absent) measures the TRUE full-width geometry (the
+# canvas_spec sentinel that disables the auto-blocking pick).
+bn = int(sys.argv[4]) if len(sys.argv) > 4 else 0
 dev = jax.devices()[0]
 assert dev.platform == "tpu", dev.platform
-out = {"grid": [M, N], "bn": bn, "device_kind": dev.device_kind}
+out = {"grid": [M, N], "bn": bn or None, "device_kind": dev.device_kind}
 try:
     problem = Problem(M=M, N=N, delta=1e-30, max_iter=iters)
     cv, cs, cw, g, rhs, sc2, _ = build_canvases(problem, None, "float32", bn)
@@ -242,11 +244,13 @@ def main() -> int:
     s.run("grid_4800x4800_bn1024",
           [py, "-c", _BIG_GRID, "4800", "4800", "50", "1024"],
           timeout=900, parse_json_tail=True)
-    s.run("grid_16384x16384", [py, "-c", _BIG_GRID, "16384", "16384", "50"],
-          timeout=1500, parse_json_tail=True)
+    # Host-side field build alone is ~6-7 min at 16384^2 (measured), plus
+    # a ~9 GiB canvas transfer through the tunnel — budget generously.
     s.run("grid_16384x16384_bn2048",
           [py, "-c", _BIG_GRID, "16384", "16384", "50", "2048"],
-          timeout=1500, parse_json_tail=True)
+          timeout=3600, parse_json_tail=True)
+    s.run("grid_16384x16384", [py, "-c", _BIG_GRID, "16384", "16384", "50"],
+          timeout=3600, parse_json_tail=True)
 
     if not args.quick:
         # 6. report artifacts

@@ -75,8 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pallas strip height (multiple of 8; default: "
                         "VMEM-budget heuristic)")
     p.add_argument("--bn", type=int, default=None,
-                   help="pallas column-block width (multiple of 128; "
-                        "default: full-width strips)")
+                   help="pallas column-block width (multiple of 128). "
+                        "Default: auto — full-width strips unless the "
+                        "canvas is too wide for a sane strip height, then "
+                        "column-blocked. 0 forces full width.")
     p.add_argument("--parallel-grid", action="store_true",
                    help="mark the pallas tile grid parallel (megacore "
                         "TensorCore split; single-device pallas backend)")
@@ -228,15 +230,11 @@ def _run_jax(args, problem: Problem, backend: str):
                 "for float64"
             )
         if args.checkpoint:
-            if args.bn is not None:
-                raise SystemExit(
-                    "--bn is not supported with --checkpoint (the portable "
-                    "checkpoint layout is full-width)"
-                )
             from poisson_tpu.ops.pallas_cg import pallas_cg_solve_checkpointed
 
             run = lambda: pallas_cg_solve_checkpointed(
-                problem, args.checkpoint, chunk=args.chunk, bm=args.bm
+                problem, args.checkpoint, chunk=args.chunk, bm=args.bm,
+                parallel=args.parallel_grid, bn=args.bn,
             )
         else:
             from poisson_tpu.ops.pallas_cg import pallas_cg_solve

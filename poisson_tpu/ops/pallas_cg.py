@@ -131,8 +131,38 @@ class Canvas(NamedTuple):
     cg: int = 0     # column guard width (LANE when blocked)
 
 
+def _width_limited_bm(problem: Problem) -> int:
+    """The strip height the VMEM budget alone allows at full width —
+    :func:`strip_height` with the owned-rows cap saturated. Distinguishes
+    'bm is small because the canvas is huge' (auto-blocking territory)
+    from 'bm is small because M is small' (leave the tiny grid alone)."""
+    return strip_height(canvas_cols(problem), 128)
+
+
 def canvas_spec(problem: Problem, bm: int | None = None,
                 bn: int | None = None) -> Canvas:
+    """``bn``: None = auto (column blocking kicks in only when full-width
+    strips degenerate on a huge canvas width); 0 = force full width (the
+    portable-checkpoint and refinement layouts); a multiple of LANE =
+    explicit blocking."""
+    if bn == 0:
+        bn = None
+    elif bm is None and bn is None and _width_limited_bm(problem) < 4 * SUBLANE:
+        # Full-width strips have degenerated (the VMEM budget divided by a
+        # huge canvas width leaves almost no rows, and the 2·HALO overfetch
+        # then dominates the stencil's reads): auto-select the widest
+        # column blocking that restores a sane strip height — wider blocks
+        # amortize the column-guard overfetch better. The height target
+        # saturates at the owned-rows cap so a short-M grid still gets the
+        # widest (least-overfetch) candidate rather than the fallback.
+        owned_cap = max(SUBLANE, -(-(problem.M - 1) // SUBLANE) * SUBLANE)
+        target = min(8 * SUBLANE, owned_cap)
+        for candidate in (4096, 2048, 1024):
+            if strip_height(candidate + 2 * LANE, problem.M - 1) >= target:
+                bn = candidate
+                break
+        else:
+            bn = 1024
     if bn is not None:
         if bn <= 0 or bn % LANE != 0:
             # Lane-dimension block offsets must stay LANE-aligned.
@@ -707,10 +737,10 @@ def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
     M, N = problem.M, problem.N
     scaled = np.asarray(rhs_grid64, np.float64) * sc64
     rhs_canvas = np.zeros((cv.rows, cv.cols), np.float64)
-    rhs_canvas[HALO : HALO + M - 1, : N + 1] = scaled[1:M, :]
+    rhs_canvas[HALO : HALO + M - 1, cv.cg : cv.cg + N + 1] = scaled[1:M, :]
     rhs = jnp.asarray(rhs_canvas, jnp.dtype(dtype_name))
     s = _fused_solve(problem, cv, interpret, parallel, cs, cw, g, rhs, sc2)
-    y = s.w[HALO : HALO + M - 1, 1:N]
+    y = s.w[HALO : HALO + M - 1, cv.cg + 1 : cv.cg + N]
     w64 = np.zeros(problem.grid_shape, np.float64)
     w64[1:M, 1:N] = np.asarray(y, np.float64) * np.asarray(
         sc_int, np.float64
@@ -765,12 +795,13 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _fused_chunk(problem: Problem, cv: Canvas, interpret: bool, chunk: int,
+                 parallel: bool,
                  cs, cw, g, sc2, s: _FusedState) -> _FusedState:
     """Advance the fused solve by at most ``chunk`` iterations."""
     body = _make_fused_body(problem, cv, interpret, cs, cw, g, sc2,
-                            s.r.dtype)
+                            s.r.dtype, parallel)
     stop_at = jnp.minimum(s.k + chunk, problem.iteration_cap)
 
     def cond(st: _FusedState):
@@ -779,13 +810,15 @@ def _fused_chunk(problem: Problem, cv: Canvas, interpret: bool, chunk: int,
     return lax.while_loop(cond, body, s)
 
 
-def _canvas_to_full(problem: Problem, c) -> np.ndarray:
+def _canvas_to_full(problem: Problem, cv: Canvas, c) -> np.ndarray:
     """Canvas interior rows → the full (M+1, N+1) grid (zero ring; canvas
-    ring columns are zero by the maskless invariant)."""
+    ring columns are zero by the maskless invariant). cg-aware: content
+    starts at canvas column cv.cg, so the portable full-grid state is
+    identical whichever canvas geometry produced it."""
     M, N = problem.M, problem.N
     c = np.asarray(c)
     full = np.zeros((M + 1, N + 1), c.dtype)
-    full[1:M, :] = c[HALO : HALO + M - 1, : N + 1]
+    full[1:M, :] = c[HALO : HALO + M - 1, cv.cg : cv.cg + N + 1]
     return full
 
 
@@ -793,7 +826,7 @@ def _full_to_canvas(problem: Problem, cv: Canvas, full) -> jnp.ndarray:
     M, N = problem.M, problem.N
     full = np.asarray(full)
     c = np.zeros((cv.rows, cv.cols), full.dtype)
-    c[HALO : HALO + M - 1, : N + 1] = full[1:M, :]
+    c[HALO : HALO + M - 1, cv.cg : cv.cg + N + 1] = full[1:M, :]
     return jnp.asarray(c)
 
 
@@ -802,11 +835,11 @@ def _fused_to_pcg_state(problem: Problem, cv: Canvas,
     """Fused state → the portable full-grid PCGState (y-space, z = r)."""
     r = np.asarray(s.r)
     d = r + float(s.beta) * np.asarray(s.p)   # updated direction z + β·p
-    r_full = _canvas_to_full(problem, s.r)
+    r_full = _canvas_to_full(problem, cv, s.r)
     return PCGState(
         k=np.asarray(s.k), done=np.asarray(s.done),
-        w=_canvas_to_full(problem, s.w), r=r_full, z=r_full,
-        p=_canvas_to_full(problem, d),
+        w=_canvas_to_full(problem, cv, s.w), r=r_full, z=r_full,
+        p=_canvas_to_full(problem, cv, d),
         zr=np.asarray(s.zr), diff=np.asarray(s.diff),
     )
 
@@ -831,10 +864,14 @@ def _pcg_state_to_fused(problem: Problem, cv: Canvas,
 def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                                  chunk: int = 200, bm: int | None = None,
                                  interpret: bool | None = None,
-                                 keep_checkpoint: bool = False) -> PCGResult:
+                                 keep_checkpoint: bool = False,
+                                 parallel: bool = False,
+                                 bn: int | None = None) -> PCGResult:
     """Fused-path solve with periodic state persistence and automatic
     resume — interoperable with the XLA fp32-scaled checkpoints (module
-    comment above). fp32 only, like the fused path itself."""
+    comment above). fp32 only, like the fused path itself. The portable
+    format is the full-grid PCGState, so any canvas geometry (full-width,
+    auto- or explicitly column-blocked) saves and resumes the same file."""
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     from poisson_tpu.solvers.checkpoint import (
@@ -845,7 +882,9 @@ def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
 
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    cv, cs, cw, g, rhs, sc2, sc_int = build_canvases(problem, bm, "float32")
+    cv, cs, cw, g, rhs, sc2, sc_int = build_canvases(
+        problem, bm, "float32", bn
+    )
     fp = _fingerprint(problem, "float32", True)
 
     saved = load_state(checkpoint_path, fp)
@@ -858,13 +897,13 @@ def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     s = run_chunked(
         s,
         advance=lambda st: _fused_chunk(problem, cv, interpret, chunk,
-                                        cs, cw, g, sc2, st),
+                                        parallel, cs, cw, g, sc2, st),
         to_portable=lambda st: _fused_to_pcg_state(problem, cv, st),
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint,
     )
 
     M, N = problem.M, problem.N
-    y = s.w[HALO : HALO + M - 1, 1:N]
+    y = s.w[HALO : HALO + M - 1, cv.cg + 1 : cv.cg + N]
     w = jnp.pad(y * sc_int, 1)
     return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr)

@@ -118,14 +118,17 @@ def test_converged_solve_skips_final_checkpoint_write(tmp_path, monkeypatch):
     assert not (tmp_path / "ck.npz").exists()
 
 
-def test_pallas_geometry_flags(capsys):
-    """--bm/--bn/--parallel-grid reach the fused path (interpret on CPU)."""
+def test_pallas_geometry_flags(capsys, tmp_path):
+    """--bm/--bn/--parallel-grid reach the fused path (interpret on CPU),
+    including the checkpointed variant (the portable format is geometry-
+    independent)."""
     assert main(["40", "40", "--backend", "pallas", "--bm", "16",
                  "--bn", "128", "--parallel-grid", "--json"]) == 0
     assert _json_line(capsys)["iterations"] == 50
-    with pytest.raises(SystemExit):
-        main(["40", "40", "--backend", "pallas", "--bn", "128",
-              "--checkpoint", "/tmp/x.npz"])
+    assert main(["40", "40", "--backend", "pallas", "--bn", "128",
+                 "--checkpoint", str(tmp_path / "ck.npz"), "--chunk", "10",
+                 "--json"]) == 0
+    assert _json_line(capsys)["iterations"] == 50
 
 
 def test_pallas_checkpoint_cli(capsys, tmp_path):

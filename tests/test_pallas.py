@@ -137,6 +137,66 @@ def test_column_blocked_golden_40x40():
     assert int(r.iterations) == 50
 
 
+def test_auto_blocking_on_degenerate_width():
+    """A canvas too wide for sane full-width strips auto-selects column
+    blocking; explicit bm, explicit bn, and the bn=0 force-full-width
+    sentinel all win over the auto pick."""
+    from poisson_tpu.ops.pallas_cg import canvas_spec
+
+    wide = Problem(M=64, N=20000)
+    cv = canvas_spec(wide)
+    assert cv.cg == 128 and cv.bm >= 64, cv
+    assert canvas_spec(wide, bm=8).cg == 0          # explicit bm: full width
+    assert canvas_spec(wide, bn=1024).bn == 1024    # explicit bn honored
+    assert canvas_spec(wide, bn=0).cg == 0          # sentinel: full width
+    # Published grids keep their proven full-width geometry.
+    assert canvas_spec(Problem(M=2400, N=3200)).cg == 0
+    # Small-M grids: bm is capped by owned rows, not width — no blocking.
+    assert canvas_spec(Problem(M=16, N=40)).cg == 0
+
+
+def test_checkpoint_layout_survives_auto_blocking():
+    """The portable checkpoint path hard-codes the full-width column
+    layout; it must keep working (and round-trip) on a grid whose default
+    solve auto-blocks."""
+    import tempfile
+
+    from poisson_tpu.ops.pallas_cg import (
+        canvas_spec, pallas_cg_solve, pallas_cg_solve_checkpointed,
+    )
+
+    wide = Problem(M=24, N=17000, max_iter=6)
+    assert canvas_spec(wide).cg == 128              # default solve blocks
+    with tempfile.TemporaryDirectory() as d:
+        got = pallas_cg_solve_checkpointed(wide, f"{d}/ck.npz", chunk=3)
+    ref = pallas_cg_solve(wide, bn=0)
+    assert int(got.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), atol=1e-6
+    )
+
+
+def test_checkpoint_portable_across_canvas_geometries(tmp_path):
+    """A checkpoint written from a column-blocked canvas resumes on the
+    full-width canvas and matches the one-shot solve: the portable format
+    is the full-grid state, independent of canvas geometry."""
+    import dataclasses
+
+    from poisson_tpu.ops.pallas_cg import pallas_cg_solve_checkpointed
+
+    p = Problem(M=40, N=300)
+    capped = dataclasses.replace(p, max_iter=20)
+    ck = str(tmp_path / "ck.npz")
+    part = pallas_cg_solve_checkpointed(capped, ck, chunk=7, bn=256)
+    assert int(part.iterations) == 20
+    got = pallas_cg_solve_checkpointed(p, ck, chunk=7, bn=0)
+    ref = pallas_cg_solve(p, bn=0)
+    assert int(got.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), atol=1e-6
+    )
+
+
 @pytest.mark.slow
 def test_column_blocked_golden_400x600():
     """Blocked path at a published grid with real multi-block seams
