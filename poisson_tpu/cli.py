@@ -81,7 +81,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "column-blocked. 0 forces full width.")
     p.add_argument("--parallel-grid", action="store_true",
                    help="mark the pallas tile grid parallel (megacore "
-                        "TensorCore split; single-device pallas backend)")
+                        "TensorCore split; pallas backends)")
     p.add_argument("--unweighted-norm", action="store_true",
                    help="stage0's unweighted convergence norm")
     p.add_argument("--repeat", type=int, default=1,
@@ -200,11 +200,11 @@ def _run_jax(args, problem: Problem, backend: str):
 
                 run = lambda: pallas_cg_solve_sharded_checkpointed(
                     problem, mesh, args.checkpoint, chunk=args.chunk,
-                    bm=args.bm,
+                    bm=args.bm, parallel=args.parallel_grid,
                 )
             else:
                 run = lambda: pallas_cg_solve_sharded(
-                    problem, mesh, bm=args.bm
+                    problem, mesh, bm=args.bm, parallel=args.parallel_grid
                 )
         elif args.checkpoint:
             if args.setup == "device":
@@ -380,10 +380,12 @@ def main(argv=None) -> int:
                 f"--bn applies to the single-device pallas backend "
                 f"(resolved backend: {backend})"
             )
-        if args.parallel_grid and backend != "pallas":
+        if args.parallel_grid and backend not in (
+            "pallas", "pallas-sharded"
+        ):
             raise SystemExit(
-                f"--parallel-grid applies to the single-device pallas "
-                f"backend (resolved backend: {backend})"
+                f"--parallel-grid applies to the pallas backends "
+                f"(resolved backend: {backend})"
             )
         if args.bm is not None and backend not in (
             "pallas", "pallas-sharded"

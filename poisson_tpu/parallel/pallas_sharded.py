@@ -190,7 +190,8 @@ def _exchange_r_halo(r, spec: ShardSpec, px: int, py: int):
 
 
 def _make_shard_body(problem: Problem, spec: ShardSpec, px: int, py: int,
-                     interpret: bool, cs, cw, g, sc2, colmask, dtype):
+                     interpret: bool, cs, cw, g, sc2, colmask, dtype,
+                     parallel: bool = False):
     """One fused sharded iteration as a pure state→state function — shared
     by the convergence while_loop and the chunked checkpointed solve."""
     cv = spec.cv
@@ -206,7 +207,7 @@ def _make_shard_body(problem: Problem, spec: ShardSpec, px: int, py: int,
         beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
         pn, ap, denom_part = direction_and_stencil(
             cv, beta, s.r, s.p, cs, cw, g, interpret=interpret,
-            band=band, colmask=colmask,
+            band=band, colmask=colmask, parallel=parallel,
         )
         # Halo rows of the new direction: identical to what the row
         # neighbour computed for its own edge (z = r and old-p halos are
@@ -224,7 +225,7 @@ def _make_shard_body(problem: Problem, spec: ShardSpec, px: int, py: int,
 
         w, r, diff_part, zr_part = fused_update(
             cv, alpha, pn, ap, sc2, s.w, s.r, interpret=interpret,
-            colmask=colmask,
+            colmask=colmask, parallel=parallel,
         )
         diff = jnp.abs(alpha32) * jnp.sqrt(psum(jnp.sum(diff_part)) * norm_w)
         zr_new = psum(jnp.sum(zr_part)) * h1h2
@@ -264,10 +265,11 @@ def _shard_init(problem: Problem, spec: ShardSpec, rhs, colmask) -> _State:
 
 
 def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
-               interpret: bool, cs, cw, g, rhs, sc2, sc_int, colmask):
+               interpret: bool, cs, cw, g, rhs, sc2, sc_int, colmask,
+               parallel: bool = False):
     lo, hi = HALO, HALO + spec.m_blk
     body = _make_shard_body(problem, spec, px, py, interpret,
-                            cs, cw, g, sc2, colmask, rhs.dtype)
+                            cs, cw, g, sc2, colmask, rhs.dtype, parallel)
 
     def cond(s: _State):
         return (~s.done) & (s.k < problem.iteration_cap)
@@ -277,9 +279,10 @@ def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
     return w_own, s.k, s.diff, s.zr
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 11))
 def _solve(problem: Problem, mesh: Mesh, spec: ShardSpec, interpret: bool,
-           cs, cw, g, rhs, sc2, sc_int, colmask) -> PCGResult:
+           cs, cw, g, rhs, sc2, sc_int, colmask,
+           parallel: bool = False) -> PCGResult:
     px = mesh.shape[X_AXIS]
     py = mesh.shape[Y_AXIS]
 
@@ -287,7 +290,7 @@ def _solve(problem: Problem, mesh: Mesh, spec: ShardSpec, interpret: bool,
         return _run_shard(
             problem, spec, px, py, interpret,
             cs_b[0], cw_b[0], g_b[0], rhs_b[0], sc2_b[0], sc_int_b[0],
-            colmask_b,
+            colmask_b, parallel,
         )
 
     stacked = P((X_AXIS, Y_AXIS))
@@ -306,13 +309,15 @@ def pallas_cg_solve_sharded(problem: Problem, mesh: Mesh,
                             bm: int | None = None,
                             interpret: bool | None = None,
                             dtype_name: str = "float32",
-                            rhs_gate=None) -> PCGResult:
+                            rhs_gate=None,
+                            parallel: bool = False) -> PCGResult:
     """Distributed solve on the fused Pallas path (fp32, scaled system).
 
     The stage4-equivalent configuration: per-shard fused kernels + mesh
     collectives. ``interpret`` defaults to True off-TPU so the kernels run
     (and are tested) on the virtual CPU mesh. ``rhs_gate`` as in
-    ``pallas_cg_solve``.
+    ``pallas_cg_solve``; ``parallel`` marks each shard's strip grid
+    parallel (megacore TensorCore split within a chip).
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -325,7 +330,7 @@ def pallas_cg_solve_sharded(problem: Problem, mesh: Mesh,
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     return _solve(problem, mesh, spec, interpret,
-                  cs, cw, g, rhs, sc2, sc_int, colmask)
+                  cs, cw, g, rhs, sc2, sc_int, colmask, parallel)
 
 
 # ---------------------------------------------------------------------------
@@ -382,9 +387,10 @@ def _scatter_canvases(problem: Problem, spec: ShardSpec, px: int, py: int,
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _chunk_solve(problem: Problem, mesh: Mesh, spec: ShardSpec,
-                 interpret: bool, chunk: int, cs, cw, g, sc2, colmask,
+                 interpret: bool, chunk: int, parallel: bool,
+                 cs, cw, g, sc2, colmask,
                  w_st, r_st, p_st, k, done, zr, beta, diff):
     px = mesh.shape[X_AXIS]
     py = mesh.shape[Y_AXIS]
@@ -393,7 +399,7 @@ def _chunk_solve(problem: Problem, mesh: Mesh, spec: ShardSpec,
                  w_b, r_b, p_b, k, done, zr, beta, diff):
         body = _make_shard_body(problem, spec, px, py, interpret,
                                 cs_b[0], cw_b[0], g_b[0], sc2_b[0],
-                                colmask_b, w_b.dtype)
+                                colmask_b, w_b.dtype, parallel)
         # Refresh halo rings (resume reconstructs them as zeros; for
         # in-memory state the exchange is value-idempotent).
         r = _exchange_r_halo(r_b[0], spec, px, py)
@@ -457,7 +463,8 @@ def pallas_cg_solve_sharded_checkpointed(
         problem: Problem, mesh: Mesh, checkpoint_path: str,
         chunk: int = 200, bm: int | None = None,
         interpret: bool | None = None,
-        keep_checkpoint: bool = False) -> PCGResult:
+        keep_checkpoint: bool = False,
+        parallel: bool = False) -> PCGResult:
     """Distributed fused-path solve with periodic state persistence and
     automatic resume (portable format — see module comment). fp32 only.
     Multi-process meshes: state is gathered to every process before the
@@ -543,7 +550,7 @@ def pallas_cg_solve_sharded_checkpointed(
     state = run_chunked(
         state,
         advance=lambda s: _CkptState(*_chunk_solve(
-            problem, mesh, spec, interpret, chunk,
+            problem, mesh, spec, interpret, chunk, parallel,
             cs, cw, g, sc2, colmask,
             s.w, s.r, s.p, s.k, s.done, s.zr, s.beta, s.diff,
         )),

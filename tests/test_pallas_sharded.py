@@ -76,3 +76,14 @@ def test_golden_400x600_on_8dev_mesh():
     got = pallas_cg_solve_sharded(p, mesh)
     assert int(got.iterations) == 546
     assert float(got.diff) < 1e-6
+
+
+def test_parallel_grid_matches_sequential_sharded():
+    """The parallel tile-grid hint on the sharded fused path is pure
+    scheduling: bit-identical solution on the same mesh."""
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices()[:4], grid=(2, 2))
+    r_seq = pallas_cg_solve_sharded(p, mesh)
+    r_par = pallas_cg_solve_sharded(p, mesh, parallel=True)
+    assert int(r_par.iterations) == int(r_seq.iterations) == 50
+    np.testing.assert_array_equal(np.asarray(r_par.w), np.asarray(r_seq.w))

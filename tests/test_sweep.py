@@ -7,6 +7,27 @@ import sys
 _BENCH_DIR = str(pathlib.Path(__file__).resolve().parents[1] / "benchmarks")
 
 
+def test_roofline_smoke(capsys):
+    """roofline.py runs end-to-end on CPU (interpret kernels) and emits a
+    well-formed report with solver rows and a stream ceiling."""
+    import json
+
+    if _BENCH_DIR not in sys.path:
+        sys.path.insert(0, _BENCH_DIR)
+    import roofline
+
+    old_argv = sys.argv
+    sys.argv = ["roofline.py", "40", "40", "--iters", "40"]
+    try:
+        assert roofline.main() == 0
+    finally:
+        sys.argv = old_argv
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert rec["platform"] == "cpu"
+    assert rec["solver"] and "mlups" in rec["solver"][0]
+
+
 def test_sweep_tiny_grid(tmp_path, capsys):
     sys.path.insert(0, _BENCH_DIR)
     try:
