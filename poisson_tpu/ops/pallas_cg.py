@@ -722,7 +722,8 @@ def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
 def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
                         interpret: bool | None = None,
                         dtype_name: str = "float32",
-                        parallel: bool = False):
+                        parallel: bool = False,
+                        bn: int | None = None):
     """Fused solve of ``A w = rhs`` for a caller-supplied RHS grid
     (fp64 host array, full (M+1, N+1) shape) — the hook mixed-precision
     refinement (``solvers.refine``) drives. Coefficient canvases come from
@@ -732,7 +733,7 @@ def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    cv, cs, cw, g, _, sc2, sc_int = build_canvases(problem, bm, dtype_name)
+    cv, cs, cw, g, _, sc2, sc_int = build_canvases(problem, bm, dtype_name, bn)
     _, _, _, _, sc64 = scaled_stencil_fields(problem)
     M, N = problem.M, problem.N
     scaled = np.asarray(rhs_grid64, np.float64) * sc64

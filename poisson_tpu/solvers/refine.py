@@ -1,0 +1,137 @@
+"""Mixed-precision iterative refinement: fp64 accuracy from the fp32 path.
+
+The reference runs everything in fp64 (SURVEY §2.5) — on TPU, fp64 is
+emulated and slow, so this framework's device path is fp32 on the
+symmetrically-scaled system (golden-count exact, ``solvers.pcg`` module
+doc). That leaves a gap the reference does not have: the *algebraic*
+residual of the fp32 solution floors around unit-roundoff of fp32. This
+module closes it with classic iterative refinement (Wilkinson; the standard
+mixed-precision HPC recipe):
+
+    w ← fp32_solve(b)                        # TPU speed
+    repeat:
+        r ← b − A·w        in fp64, on host  # exact residual
+        e ← fp32_solve(r)                    # TPU speed
+        w ← w + e          in fp64
+
+Each pass multiplies the residual by O(ε₃₂·κ), so 2-3 passes reach the
+fp64 floor while every inner solve runs at fp32 throughput. The inner
+solver is the fused Pallas path's arbitrary-RHS hook
+(``ops.pallas_cg.pallas_cg_solve_rhs``), built for exactly this driver.
+
+**Which residual.** The fictitious-domain operator carries 1/ε
+coefficients outside D (ε = max(h)², SURVEY §2.1) — its stiff directions
+turn a harmless O(ε) perturbation of the (≈0) fictitious-region solution
+into an O(1) raw residual, which is also why the reference's convergence
+criterion is the update norm, not the residual. The meaningful algebraic
+measure is the residual of the symmetrically-scaled system
+Ã = D^{-1/2}AD^{-1/2} (unit diagonal, O(1) spectrum away from 1/ε):
+      r̃ = D^{-1/2}·(b − A·w),   converge on ‖r̃‖/‖D^{-1/2}b‖ ≤ tol.
+Refinement drives THAT to the fp64 floor (~1e-15 reachable; default tol
+1e-10), far below the single-fp32-solve floor (tests/test_refine.py).
+Measured contraction is ~25-30× per pass (400×600: 7.3e-5 → 8.2e-15 over
+7 corrections) — governed by the inner solver's δ=1e-6 update-norm
+criterion, not by fp32 limits, so passes are cheap-ish (a few hundred CG
+iterations each) and the default budget is 8.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers.pcg import host_fields64
+
+
+class RefineResult(NamedTuple):
+    w: np.ndarray                 # fp64 solution, full (M+1, N+1) grid
+    residual_norms: tuple         # weighted L2 of D^{-1/2}(b − A·w) per pass
+    inner_iterations: tuple       # PCG iterations of each inner solve
+    refinements: int
+    relative_residual: float      # final ‖D^{-1/2}(b−A·w)‖ / ‖D^{-1/2}b‖
+    converged: bool               # relative_residual <= tol was reached
+
+
+def apply_A64_host(problem: Problem, a64, b64, w64) -> np.ndarray:
+    """The 5-point variable-coefficient operator in fp64 numpy, interior
+    points only (zero ring preserved) — the host-side exact-residual
+    oracle. Mirrors ``ops.stencil.apply_A`` (and the reference's ``mat_A``,
+    ``stage0/Withoutopenmp1.cpp:75-88``) with numpy slices."""
+    h1sq, h2sq = problem.h1 ** 2, problem.h2 ** 2
+    out = np.zeros_like(w64)
+    c = w64[1:-1, 1:-1]
+    ax = a64[1:-1, 1:-1]        # a[i, j]   (south face of point (i, j))
+    axn = a64[2:, 1:-1]         # a[i+1, j] (north face)
+    bw = b64[1:-1, 1:-1]        # b[i, j]   (west face)
+    be = b64[1:-1, 2:]          # b[i, j+1] (east face)
+    out[1:-1, 1:-1] = (
+        -(axn * (w64[2:, 1:-1] - c) - ax * (c - w64[:-2, 1:-1])) / h1sq
+        - (be * (w64[1:-1, 2:] - c) - bw * (c - w64[1:-1, :-2])) / h2sq
+    )
+    return out
+
+
+def _weighted_norm(problem: Problem, v64) -> float:
+    return float(np.sqrt(np.sum(v64 * v64) * problem.h1 * problem.h2))
+
+
+def refined_solve(problem: Problem, tol: float = 1e-10,
+                  max_refinements: int = 8,
+                  bm: int | None = None, bn: int | None = None,
+                  interpret: bool | None = None,
+                  parallel: bool = False) -> RefineResult:
+    """Solve A w = B to relative *scaled-system* residual ``tol``
+    (module doc: the raw residual is 1/ε-stiffness-dominated and
+    meaningless here) using fp32 device solves plus fp64 host residuals.
+
+    Stops when ‖D^{-1/2}(b − A·w)‖ / ‖D^{-1/2}b‖ ≤ tol or after
+    ``max_refinements`` correction passes. Geometry/scheduling knobs are
+    forwarded to the fused inner solver.
+    """
+    from poisson_tpu.ops.pallas_cg import pallas_cg_solve_rhs
+
+    a64, b64, rhs64, sc64 = _fields(problem)
+    bt_norm = _weighted_norm(problem, sc64 * rhs64)   # ‖b̃‖
+    if bt_norm == 0.0:
+        return RefineResult(np.zeros_like(rhs64), (0.0,), (), 0, 0.0, True)
+
+    w64 = np.zeros_like(rhs64)
+    norms = []
+    inner = []
+    residual = rhs64
+    rt_norm = bt_norm
+    for k in range(max_refinements + 1):
+        # The inner solver stops on an ABSOLUTE update norm (the
+        # reference's δ=1e-6 criterion); a correction RHS is orders of
+        # magnitude smaller than b, so normalize it to b's scale before the
+        # solve and scale the correction back (exact by linearity) — each
+        # pass then does the same well-conditioned amount of work.
+        scale = bt_norm / rt_norm
+        e64, iters = pallas_cg_solve_rhs(
+            problem, residual * scale, bm=bm, interpret=interpret,
+            parallel=parallel, bn=bn,
+        )
+        w64 = w64 + e64 / scale
+        inner.append(iters)
+        residual = rhs64 - apply_A64_host(problem, a64, b64, w64)
+        rt_norm = _weighted_norm(problem, sc64 * residual)
+        norms.append(rt_norm)
+        if rt_norm / bt_norm <= tol or rt_norm == 0.0:
+            break
+    rel = rt_norm / bt_norm
+    return RefineResult(
+        w=w64, residual_norms=tuple(norms),
+        inner_iterations=tuple(inner), refinements=len(inner) - 1,
+        relative_residual=rel, converged=bool(rel <= tol),
+    )
+
+
+def _fields(problem: Problem):
+    """(a, b, B, sc) in fp64: the UNSCALED operator fields the residual is
+    exact for, plus the scaling vector sc = D^{-1/2} (zero ring) defining
+    the residual metric."""
+    a64, b64, rhs64, _ = host_fields64(problem, False)
+    _, _, _, sc64 = host_fields64(problem, True)
+    return a64, b64, rhs64, sc64
