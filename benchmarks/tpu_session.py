@@ -90,6 +90,10 @@ class Session:
             payload["result"] = parsed
         else:
             payload["stdout"] = out[-2000:]
+        if proc.stderr.strip():
+            # Warnings ride along even on success — e.g. bench.py reports
+            # a backend fallback (and why) on stderr while still exiting 0.
+            payload["stderr"] = proc.stderr.strip()[-1500:]
         self.record(step, payload)
         return parsed if parse_json_tail else payload
 

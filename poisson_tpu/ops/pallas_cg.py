@@ -66,6 +66,7 @@ the one (documented) semantic difference from ``solvers.pcg.pcg_loop``.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -87,6 +88,28 @@ LANE = 128      # TPU lane width: canvas columns padded to a multiple of this
 SUBLANE = 8     # fp32 sublane granule: strip heights in multiples of this
 HALO = SUBLANE  # strip halo rows: 1 would do, 8 keeps blocks sublane-aligned
 VMEM_BUDGET = 12 * 2 ** 20  # leave headroom under the ~16 MB/core VMEM
+
+
+# Reduction-partial layout escape hatch, frozen at import so every jit
+# cache in the process agrees with the kernels it compiled (flipping the
+# env var later would otherwise silently reuse the other layout's
+# executable — A/B runs use fresh subprocesses).
+#
+# Default (off): each grid step writes its partial to its own row of an
+# (nb, 1)/(nb, ncb) SMEM output and the caller tree-sums — the
+# accuracy-preferred layout. ``POISSON_TPU_SERIAL_REDUCE=1`` switches to a
+# single (1, 1) SMEM cell accumulated across grid steps — the layout the
+# round-2 TPU measurements compiled — with Kahan compensation in an SMEM
+# scratch cell, which removes the serial-rounding L2 loss that motivated
+# the per-strip partials in the first place (the compensated sum over
+# ≤~10³ strip partials is exact to fp32 for this system). Sequential by
+# construction, so it forces the tile grid's ``parallel`` (megacore)
+# marking off.
+SERIAL_REDUCE = os.environ.get("POISSON_TPU_SERIAL_REDUCE", "0") == "1"
+
+
+def serial_reduce_enabled() -> bool:
+    return SERIAL_REDUCE
 
 
 def strip_height(cols: int, owned_rows: int) -> int:
@@ -291,7 +314,7 @@ def _shift_col_plus(u):
 
 
 def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
-                                   masked: bool):
+                                   masked: bool, serial: bool = False):
     """Kernel A: p ← z + β·p, Ap ← Ãp, accumulate ⟨Ap, p⟩.
 
     Strip refs are (BM+2·HALO, C) halo-inclusive; outputs are the BM center
@@ -322,6 +345,9 @@ def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
     band_lo, band_hi = band
 
     def kernel(beta_ref, z_ref, p_ref, cs_ref, cw_ref, g_ref, *rest):
+        comp_ref = None
+        if serial:
+            *rest, comp_ref = rest
         if masked:
             colmask_ref, pn_ref, ap_ref, denom_ref = rest
         else:
@@ -356,13 +382,44 @@ def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
         # Per-strip partial only: strip i owns row i of an (nb, 1) output and
         # XLA tree-sums the partials outside the kernel. A single SMEM scalar
         # accumulated across strips rounds serially (nb-long dependence
-        # chain), which cost 6× in L2 accuracy at 2400×3200.
-        denom_ref[0, 0] = jnp.sum(apc, dtype=jnp.float32)
+        # chain), which cost 6× in L2 accuracy at 2400×3200 — the serial
+        # variant compensates with a Kahan scratch cell instead.
+        part = jnp.sum(apc, dtype=jnp.float32)
+        if serial:
+            _kahan_add(i == 0, denom_ref, comp_ref, 0, part)
+        else:
+            denom_ref[0, 0] = part
 
     return kernel
 
 
-def _make_blocked_stencil_kernel(cv: Canvas, band: tuple[int, int]):
+def _is_first_step(ndims: int):
+    """True on the first step of an ``ndims``-dimensional sequential grid."""
+    first = pl.program_id(0) == 0
+    for d in range(1, ndims):
+        first &= pl.program_id(d) == 0
+    return first
+
+
+def _kahan_add(first, out_ref, comp_ref, slot: int, part):
+    """Compensated accumulation of ``part`` into the (1, 1) ``out_ref``
+    with the running compensation in ``comp_ref[slot]`` (SMEM scratch,
+    which persists across the sequential grid steps). ``first`` zeroes
+    both."""
+
+    @pl.when(first)
+    def _():
+        out_ref[0, 0] = 0.0
+        comp_ref[slot] = 0.0
+
+    y = part - comp_ref[slot]
+    t = out_ref[0, 0] + y
+    comp_ref[slot] = (t - out_ref[0, 0]) - y
+    out_ref[0, 0] = t
+
+
+def _make_blocked_stencil_kernel(cv: Canvas, band: tuple[int, int],
+                                 serial: bool = False):
     """Column-blocked kernel A (single-device layouts only): the full-width
     kernel's math on a (strip, column-block) 2D grid. Column guards play
     the role row guards play in the full-width layout — every ±1-column
@@ -374,7 +431,7 @@ def _make_blocked_stencil_kernel(cv: Canvas, band: tuple[int, int]):
     band_lo, band_hi = band
 
     def kernel(beta_ref, z_ref, p_ref, cs_ref, cw_ref, g_ref,
-               pn_ref, ap_ref, denom_ref):
+               pn_ref, ap_ref, denom_ref, *scratch):
         i = pl.program_id(0)
         j = pl.program_id(1)
         beta = beta_ref[0, 0]
@@ -405,12 +462,16 @@ def _make_blocked_stencil_kernel(cv: Canvas, band: tuple[int, int]):
         ap_ref[:] = ap
         # Per-tile partial (row i, col j of an (nb, ncb) output); the
         # caller tree-sums, same accuracy rationale as the strip partials.
-        denom_ref[0, 0] = jnp.sum(ap * c, dtype=jnp.float32)
+        part = jnp.sum(ap * c, dtype=jnp.float32)
+        if serial:
+            _kahan_add(_is_first_step(2), denom_ref, scratch[0], 0, part)
+        else:
+            denom_ref[0, 0] = part
 
     return kernel
 
 
-def _make_update_kernel(masked: bool):
+def _make_update_kernel(masked: bool, serial: bool = False, ndims: int = 1):
     """Kernel B: w ← w + α·p, r ← r − α·Ap, accumulate Σp²·sc² and Σr².
 
     ``masked`` adds a (1, C) column mask multiplying the Σr² partial (the
@@ -419,6 +480,9 @@ def _make_update_kernel(masked: bool):
     pre-zeroed outside the owned interior."""
 
     def kernel(alpha_ref, p_ref, ap_ref, sc2_ref, *rest):
+        comp_ref = None
+        if serial:
+            *rest, comp_ref = rest
         if masked:
             colmask_ref, w_ref, r_ref, w_out_ref, r_out_ref, diff_ref, zr_ref = rest
         else:
@@ -432,8 +496,15 @@ def _make_update_kernel(masked: bool):
         if masked:
             rr = rr * colmask_ref[:]
         # Per-strip partials (see kernel A): row i of the (nb, 1) outputs.
-        diff_ref[0, 0] = jnp.sum(p * p * sc2_ref[:], dtype=jnp.float32)
-        zr_ref[0, 0] = jnp.sum(rr, dtype=jnp.float32)
+        d_part = jnp.sum(p * p * sc2_ref[:], dtype=jnp.float32)
+        z_part = jnp.sum(rr, dtype=jnp.float32)
+        if serial:
+            first = _is_first_step(ndims)
+            _kahan_add(first, diff_ref, comp_ref, 0, d_part)
+            _kahan_add(first, zr_ref, comp_ref, 1, z_part)
+        else:
+            diff_ref[0, 0] = d_part
+            zr_ref[0, 0] = z_part
 
     return kernel
 
@@ -538,19 +609,29 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
     single-device only (the sharded layouts stay full-width)."""
     if band is None:
         band = (HALO, cv.rows - HALO)
+    serial = serial_reduce_enabled()
+    if serial:
+        parallel = False          # cross-step SMEM accumulation is sequential
     if cv.cg:
         assert colmask is None, "column blocking is single-device only"
         strip, cs_spec, cw_spec, block, scalar, partial = _blk_specs(cv)
+        if serial:
+            partial = scalar      # one (1, 1) cell instead of (nb, ncb)
         return pl.pallas_call(
-            _make_blocked_stencil_kernel(cv, band),
+            _make_blocked_stencil_kernel(cv, band, serial),
             grid=(cv.nb, cv.ncb),
             in_specs=[scalar, strip, strip, cs_spec, cw_spec, block],
             out_specs=[block, block, partial],
             out_shape=[
                 _canvas_shape(cv, p.dtype),
                 _canvas_shape(cv, p.dtype),
-                jax.ShapeDtypeStruct((cv.nb, cv.ncb), jnp.float32),
+                jax.ShapeDtypeStruct(
+                    (1, 1) if serial else (cv.nb, cv.ncb), jnp.float32
+                ),
             ],
+            scratch_shapes=(
+                [pltpu.SMEM((1,), jnp.float32)] if serial else []
+            ),
             interpret=interpret,
             **_grid_params(parallel, 2),
         )(beta, z, p, cs, cw, g)
@@ -568,15 +649,21 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
         in_specs.append(_colmask_spec(cv))
         operands.append(colmask)
     return pl.pallas_call(
-        _make_direction_stencil_kernel(cv, band, masked),
+        _make_direction_stencil_kernel(cv, band, masked, serial),
         grid=(cv.nb,),
         in_specs=in_specs,
-        out_specs=[_block_spec(cv), _block_spec(cv), _partial_out_spec()],
+        out_specs=[
+            _block_spec(cv),
+            _block_spec(cv),
+            _scalar_spec() if serial else _partial_out_spec(),
+        ],
         out_shape=[
             _canvas_shape(cv, p.dtype),
             _canvas_shape(cv, p.dtype),
-            jax.ShapeDtypeStruct((cv.nb, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1) if serial else (cv.nb, 1),
+                                 jnp.float32),
         ],
+        scratch_shapes=([pltpu.SMEM((1,), jnp.float32)] if serial else []),
         interpret=interpret,
         **_grid_params(parallel),
     )(*operands)
@@ -588,21 +675,32 @@ def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
     tree-sums) — one HBM sweep. Column-blocked canvases run the same
     kernel body on the (strip, column-block) 2D grid with (nb, ncb)
     partials."""
+    serial = serial_reduce_enabled()
+    if serial:
+        parallel = False          # cross-step SMEM accumulation is sequential
     if cv.cg:
         assert colmask is None, "column blocking is single-device only"
         _, _, _, block, scalar, partial = _blk_specs(cv)
+        if serial:
+            partial = scalar
+        pshape = jax.ShapeDtypeStruct(
+            (1, 1) if serial else (cv.nb, cv.ncb), jnp.float32
+        )
         return pl.pallas_call(
-            _make_update_kernel(masked=False),
+            _make_update_kernel(masked=False, serial=serial, ndims=2),
             grid=(cv.nb, cv.ncb),
             in_specs=[scalar, block, block, block, block, block],
             out_specs=[block, block, partial, partial],
             out_shape=[
                 _canvas_shape(cv, w.dtype),
                 _canvas_shape(cv, w.dtype),
-                jax.ShapeDtypeStruct((cv.nb, cv.ncb), jnp.float32),
-                jax.ShapeDtypeStruct((cv.nb, cv.ncb), jnp.float32),
+                pshape,
+                pshape,
             ],
             input_output_aliases={4: 0, 5: 1},  # w → w', r → r'
+            scratch_shapes=(
+                [pltpu.SMEM((2,), jnp.float32)] if serial else []
+            ),
             interpret=interpret,
             **_grid_params(parallel, 2),
         )(alpha, p, ap, sc2, w, r)
@@ -620,23 +718,27 @@ def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
     w_idx = len(operands)
     in_specs += [_block_spec(cv), _block_spec(cv)]
     operands += [w, r]
+    pspec = _scalar_spec() if serial else _partial_out_spec()
+    pshape = jax.ShapeDtypeStruct((1, 1) if serial else (cv.nb, 1),
+                                  jnp.float32)
     return pl.pallas_call(
-        _make_update_kernel(masked),
+        _make_update_kernel(masked, serial),
         grid=(cv.nb,),
         in_specs=in_specs,
         out_specs=[
             _block_spec(cv),
             _block_spec(cv),
-            _partial_out_spec(),
-            _partial_out_spec(),
+            pspec,
+            pspec,
         ],
         out_shape=[
             _canvas_shape(cv, w.dtype),
             _canvas_shape(cv, w.dtype),
-            jax.ShapeDtypeStruct((cv.nb, 1), jnp.float32),
-            jax.ShapeDtypeStruct((cv.nb, 1), jnp.float32),
+            pshape,
+            pshape,
         ],
         input_output_aliases={w_idx: 0, w_idx + 1: 1},  # w → w', r → r'
+        scratch_shapes=([pltpu.SMEM((2,), jnp.float32)] if serial else []),
         interpret=interpret,
         **_grid_params(parallel),
     )(*operands)

@@ -222,3 +222,60 @@ def test_gate_is_bit_exact():
     r2 = pallas_cg_solve(p, rhs_gate=jnp.float32(1.0))
     assert int(r1.iterations) == int(r2.iterations)
     assert np.array_equal(np.asarray(r1.w), np.asarray(r2.w))
+
+
+@pytest.mark.slow
+def test_serial_kahan_reduce_layout_matches_partials():
+    """POISSON_TPU_SERIAL_REDUCE=1 switches the reduction partials from
+    per-strip (nb, 1) SMEM rows to one Kahan-compensated SMEM cell (the
+    layout hardware-proven in round 2). Import-frozen, so the variant runs
+    in a subprocess; it must reproduce the golden counts and the default
+    layout's L2 on the single-device, column-blocked, and sharded paths."""
+    import json
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    code = r"""
+import json
+from poisson_tpu.utils.platform import honor_jax_platforms_env
+honor_jax_platforms_env()   # config beats env: re-assert JAX_PLATFORMS=cpu
+from poisson_tpu.config import Problem
+from poisson_tpu.ops.pallas_cg import pallas_cg_solve, SERIAL_REDUCE
+from poisson_tpu.analysis import l2_error_host
+assert SERIAL_REDUCE
+out = {}
+p = Problem(M=400, N=600)
+r = pallas_cg_solve(p)
+out["single"] = [int(r.iterations), l2_error_host(p, r.w)]
+r = pallas_cg_solve(p, bn=256)
+out["blocked"] = [int(r.iterations), l2_error_host(p, r.w)]
+import jax
+from poisson_tpu.parallel import make_solver_mesh
+from poisson_tpu.parallel.pallas_sharded import pallas_cg_solve_sharded
+mesh = make_solver_mesh(jax.devices()[:4], grid=(2, 2))
+r = pallas_cg_solve_sharded(Problem(M=40, N=40), mesh)
+out["sharded_2x2"] = [int(r.iterations)]
+print(json.dumps(out))
+"""
+    env = dict(os.environ)
+    env["POISSON_TPU_SERIAL_REDUCE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    root = pathlib.Path(__file__).resolve().parents[1]
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(root)] + [p for p in [env.get("PYTHONPATH", "")] if p]
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=root, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    got = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert got["single"][0] == 546
+    assert got["blocked"][0] == 546
+    assert got["sharded_2x2"][0] == 50
+    assert got["single"][1] < 4e-4 and got["blocked"][1] < 4e-4
