@@ -145,6 +145,11 @@ def main() -> int:
     from poisson_tpu.solvers.pcg import pcg_solve
     from poisson_tpu.utils.timing import fence, mlups
 
+    # Read the env contract directly, NOT via ops.pallas_cg: a pallas
+    # import failure must stay inside the backend try-block below so the
+    # bench can still fall back to xla and produce its artifact.
+    serial_reduce = os.environ.get("POISSON_TPU_SERIAL_REDUCE", "0") == "1"
+
     # Default: the flagship 800×1200 (the driver contract). An explicit
     # `python bench.py M N` benches another grid with the same methodology.
     if len(sys.argv) == 3:
@@ -285,6 +290,10 @@ def main() -> int:
             "backend": backend,
             "devices": len(devices),
             "platform": platform,
+            # Kernel reduction-partial layout (ops.pallas_cg): the two
+            # layouts are numerically equivalent but compile differently,
+            # so the artifact must say which one set a record.
+            "serial_reduce": serial_reduce,
         },
     }
     flagship = (problem.M, problem.N) == (800, 1200)

@@ -92,10 +92,19 @@ def _solver_iter_seconds(problem, bm: int | None, iters: int,
         raise ValueError(f"need --iters >= 20 for a meaningful slope, got {iters}")
     lo = dataclasses.replace(problem, delta=1e-30, max_iter=iters // 4)
     hi = dataclasses.replace(problem, delta=1e-30, max_iter=iters)
+
+    from poisson_tpu.ops.pallas_cg import _resolve_serial
+
+    # Resolve BEFORE the canvas build: a doomed serial+parallel row must
+    # fail instantly (still recorded as an error row), not after a
+    # multi-GB host build + tunnel transfer. Also guarantees a sweep can
+    # never record a 'parallel' row that actually ran serial.
+    serial = _resolve_serial(None, parallel)
     cv, cs, cw, g, rhs, sc2, _ = build_canvases(hi, bm, "float32", bn)
 
     def run(p):
-        s = _fused_solve(p, cv, interpret, parallel, cs, cw, g, rhs, sc2)
+        s = _fused_solve(p, cv, interpret, parallel, serial,
+                         cs, cw, g, rhs, sc2)
         s.diff.block_until_ready()
         return s
 
@@ -120,6 +129,7 @@ def _solver_iter_seconds(problem, bm: int | None, iters: int,
     passes = (2 * row_of * col_of + row_of + col_of + 1 + 2) + (5 + 2)
     geom = {
         "bm": cv.bm, "nb": cv.nb, "bn": cv.bn or None, "ncb": cv.ncb,
+        "serial_reduce": serial,
         "canvas_rows": cv.rows,
         "canvas_cols": cv.cols, "canvas_mb": round(canvas_bytes / 2**20, 1),
         "model_passes": round(passes, 2),

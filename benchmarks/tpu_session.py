@@ -98,6 +98,38 @@ class Session:
         return parsed if parse_json_tail else payload
 
 
+_KERNEL_PROBE = r"""
+import json, sys, time
+from poisson_tpu.utils.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+from poisson_tpu.analysis import l2_error_host
+from poisson_tpu.config import Problem
+from poisson_tpu.ops.pallas_cg import pallas_cg_solve, SERIAL_REDUCE
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev.platform
+out = {"serial_reduce": SERIAL_REDUCE}
+try:
+    p = Problem(M=40, N=40)
+    r = pallas_cg_solve(p)
+    out["tiny_iters"] = int(r.iterations)
+    p = Problem(M=800, N=1200)
+    t0 = time.perf_counter()
+    r = pallas_cg_solve(p)
+    k = int(r.iterations)
+    # Same tolerance bench.py grants its sanity probe: reduction-order
+    # drift of O(0.1%) is healthy; anything larger means broken kernels.
+    out.update(ok=(abs(out["tiny_iters"] - 50) <= 5 and abs(k - 989) <= 9),
+               flagship_iters=k, l2=l2_error_host(p, r.w),
+               compile_and_first_s=round(time.perf_counter() - t0, 1))
+except Exception as e:
+    import traceback
+    out.update(ok=False, error=traceback.format_exc()[-1800:])
+print(json.dumps(out))
+"""
+
+
 _SHARDED_1X1 = r"""
 import json
 from poisson_tpu.utils.platform import honor_jax_platforms_env
@@ -141,7 +173,9 @@ honor_jax_platforms_env()
 import jax
 import jax.numpy as jnp
 from poisson_tpu.config import Problem
-from poisson_tpu.ops.pallas_cg import build_canvases, _fused_solve
+from poisson_tpu.ops.pallas_cg import (
+    SERIAL_REDUCE, build_canvases, _fused_solve,
+)
 
 M, N, iters = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
 # argv bn: 0 (or absent) measures the TRUE full-width geometry (the
@@ -149,7 +183,8 @@ M, N, iters = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
 bn = int(sys.argv[4]) if len(sys.argv) > 4 else 0
 dev = jax.devices()[0]
 assert dev.platform == "tpu", dev.platform
-out = {"grid": [M, N], "bn": bn or None, "device_kind": dev.device_kind}
+out = {"grid": [M, N], "bn": bn or None, "serial_reduce": SERIAL_REDUCE,
+       "device_kind": dev.device_kind}
 try:
     problem = Problem(M=M, N=N, delta=1e-30, max_iter=iters)
     cv, cs, cw, g, rhs, sc2, _ = build_canvases(problem, None, "float32", bn)
@@ -157,16 +192,16 @@ try:
     out.update(bm=cv.bm, nb=cv.nb, canvas_rows=cv.rows, canvas_cols=cv.cols,
                working_set_gb=round(canvases_gb, 2))
     lo = dataclasses.replace(problem, max_iter=max(5, iters // 4))
-    s = _fused_solve(lo, cv, False, False, cs, cw, g, rhs, sc2)
+    s = _fused_solve(lo, cv, False, False, SERIAL_REDUCE, cs, cw, g, rhs, sc2)
     s.diff.block_until_ready()
     t0 = time.perf_counter()
-    s = _fused_solve(lo, cv, False, False, cs, cw, g, rhs, sc2)
+    s = _fused_solve(lo, cv, False, False, SERIAL_REDUCE, cs, cw, g, rhs, sc2)
     s.diff.block_until_ready()
     t_lo = time.perf_counter() - t0
-    s = _fused_solve(problem, cv, False, False, cs, cw, g, rhs, sc2)
+    s = _fused_solve(problem, cv, False, False, SERIAL_REDUCE, cs, cw, g, rhs, sc2)
     s.diff.block_until_ready()
     t0 = time.perf_counter()
-    s = _fused_solve(problem, cv, False, False, cs, cw, g, rhs, sc2)
+    s = _fused_solve(problem, cv, False, False, SERIAL_REDUCE, cs, cw, g, rhs, sc2)
     s.diff.block_until_ready()
     t_hi = time.perf_counter() - t0
     per_iter = (t_hi - t_lo) / (problem.max_iter - lo.max_iter)
@@ -212,6 +247,56 @@ def main() -> int:
     if not ident or ident.get("platform") != "tpu":
         s.record("abort", {"reason": "tunnel not healthy; nothing captured"})
         return 1
+
+    # 1.5 kernel health: the fused path must actually run on hardware
+    # before anything downstream leans on it. If the default per-strip
+    # partial layout fails Mosaic, A/B the serial-Kahan layout and — when
+    # it works — adopt it for every remaining step (subprocesses inherit
+    # our env). Produces the layout A/B evidence either way.
+    probe = s.run("kernel_probe", [py, "-c", _KERNEL_PROBE],
+                  timeout=900, parse_json_tail=True)
+    if probe is None:
+        # Timeout / no result is a tunnel statement, not a kernel one —
+        # it must not indict the default layout. One retry; if still
+        # inconclusive, keep the default and make no layout claim.
+        probe = s.run("kernel_probe_retry", [py, "-c", _KERNEL_PROBE],
+                      timeout=900, parse_json_tail=True)
+    if probe is None:
+        s.record("layout_decision", {
+            "serial_reduce": False,
+            "reason": "default-layout probe inconclusive twice (timeout "
+                      "or no result); keeping the default — no statement "
+                      "about either layout's hardware health",
+        })
+    elif not probe.get("ok"):
+        # Definitive in-process verdict against the default layout: an
+        # exception or suspect iteration counts. A/B the serial layout.
+        if "error" in probe:
+            default_verdict = "failed on hardware (exception)"
+        else:
+            default_verdict = (
+                f"suspect iteration counts ({probe.get('tiny_iters')}, "
+                f"{probe.get('flagship_iters')})"
+            )
+        os.environ["POISSON_TPU_SERIAL_REDUCE"] = "1"
+        probe2 = s.run("kernel_probe_serial", [py, "-c", _KERNEL_PROBE],
+                       timeout=900, parse_json_tail=True)
+        if probe2 and probe2.get("ok"):
+            s.record("layout_decision", {
+                "serial_reduce": True,
+                "reason": f"default per-strip partial layout "
+                          f"{default_verdict}; serial-Kahan layout probed "
+                          "healthy and is adopted for the rest of the "
+                          "session",
+            })
+        else:
+            del os.environ["POISSON_TPU_SERIAL_REDUCE"]
+            s.record("layout_decision", {
+                "serial_reduce": False,
+                "reason": f"default layout {default_verdict}; serial "
+                          "layout did not probe healthy either — keeping "
+                          "the default (XLA fallbacks carry the session)",
+            })
 
     # 2. benches (flagship first: refreshes BENCH_TPU_GOOD.json)
     for grid, to in (((800, 1200), 900), ((1600, 2400), 1200),

@@ -112,6 +112,23 @@ def serial_reduce_enabled() -> bool:
     return SERIAL_REDUCE
 
 
+def _resolve_serial(serial: bool | None, parallel: bool) -> bool:
+    """Resolve a ``serial`` knob (None = the env default) against the
+    ``parallel`` grid marking. The two are contradictory — serial
+    accumulation is ordered across grid steps — and silently preferring
+    one would fabricate A/B evidence (a 'parallel' row that actually ran
+    sequentially), so the combination raises instead."""
+    if serial is None:
+        serial = SERIAL_REDUCE
+    if serial and parallel:
+        raise ValueError(
+            "serial (Kahan) reduction accumulates across sequential grid "
+            "steps; a parallel tile grid cannot honor it — pass one or "
+            "the other"
+        )
+    return serial
+
+
 def strip_height(cols: int, owned_rows: int) -> int:
     """Strip height for a canvas of ``cols`` columns covering ``owned_rows``
     interior rows: fills the VMEM budget at ~12 strip-buffers in flight
@@ -599,7 +616,8 @@ def _grid_params(parallel: bool, ndims: int = 1):
 def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
                           interpret: bool,
                           band: tuple[int, int] | None = None, colmask=None,
-                          parallel: bool = False):
+                          parallel: bool = False,
+                          serial: bool | None = None):
     """p_new, Ap, per-strip ⟨Ap, p_new⟩ partials ((nb, 1), unweighted; caller
     tree-sums) — one HBM sweep.
 
@@ -609,9 +627,7 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
     single-device only (the sharded layouts stay full-width)."""
     if band is None:
         band = (HALO, cv.rows - HALO)
-    serial = serial_reduce_enabled()
-    if serial:
-        parallel = False          # cross-step SMEM accumulation is sequential
+    serial = _resolve_serial(serial, parallel)
     if cv.cg:
         assert colmask is None, "column blocking is single-device only"
         strip, cs_spec, cw_spec, block, scalar, partial = _blk_specs(cv)
@@ -670,14 +686,13 @@ def direction_and_stencil(cv: Canvas, beta, z, p, cs, cw, g, *,
 
 
 def fused_update(cv: Canvas, alpha, p, ap, sc2, w, r, *, interpret: bool,
-                 colmask=None, parallel: bool = False):
+                 colmask=None, parallel: bool = False,
+                 serial: bool | None = None):
     """w', r', per-strip Σ p²·sc² and Σ r'² partials ((nb, 1) each; caller
     tree-sums) — one HBM sweep. Column-blocked canvases run the same
     kernel body on the (strip, column-block) 2D grid with (nb, ncb)
     partials."""
-    serial = serial_reduce_enabled()
-    if serial:
-        parallel = False          # cross-step SMEM accumulation is sequential
+    serial = _resolve_serial(serial, parallel)
     if cv.cg:
         assert colmask is None, "column blocking is single-device only"
         _, _, _, block, scalar, partial = _blk_specs(cv)
@@ -756,7 +771,8 @@ class _FusedState(NamedTuple):
 
 
 def _make_fused_body(problem: Problem, cv: Canvas, interpret: bool,
-                     cs, cw, g, sc2, dtype, parallel: bool = False):
+                     cs, cw, g, sc2, dtype, parallel: bool = False,
+                     serial: bool = False):
     """One fused iteration (kernels A + B) as a pure state→state function —
     shared by the convergence while_loop and the chunked checkpointed
     solve."""
@@ -767,7 +783,7 @@ def _make_fused_body(problem: Problem, cv: Canvas, interpret: bool,
         beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
         pn, ap, denom_part = direction_and_stencil(
             cv, beta, s.r, s.p, cs, cw, g, interpret=interpret,
-            parallel=parallel,
+            parallel=parallel, serial=serial,
         )
         denom = jnp.sum(denom_part) * h1h2
         degenerate = jnp.abs(denom) < _DENOM_TOL
@@ -775,7 +791,7 @@ def _make_fused_body(problem: Problem, cv: Canvas, interpret: bool,
         alpha = jnp.reshape(alpha32, (1, 1)).astype(dtype)
         w, r, diff_part, zr_part = fused_update(
             cv, alpha, pn, ap, sc2, s.w, s.r, interpret=interpret,
-            parallel=parallel,
+            parallel=parallel, serial=serial,
         )
         diff = jnp.abs(alpha32) * jnp.sqrt(jnp.sum(diff_part) * norm_w)
         zr_new = jnp.sum(zr_part) * h1h2
@@ -806,12 +822,12 @@ def _fused_init(cv: Canvas, rhs) -> _FusedState:
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _fused_solve(problem: Problem, cv: Canvas, interpret: bool,
-                 parallel: bool, cs, cw, g, rhs, sc2):
+                 parallel: bool, serial: bool, cs, cw, g, rhs, sc2):
     dtype = rhs.dtype
     body = _make_fused_body(problem, cv, interpret, cs, cw, g, sc2, dtype,
-                            parallel)
+                            parallel, serial)
 
     def cond(s: _FusedState):
         return (~s.done) & (s.k < problem.iteration_cap)
@@ -825,7 +841,8 @@ def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
                         interpret: bool | None = None,
                         dtype_name: str = "float32",
                         parallel: bool = False,
-                        bn: int | None = None):
+                        bn: int | None = None,
+                        serial: bool | None = None):
     """Fused solve of ``A w = rhs`` for a caller-supplied RHS grid
     (fp64 host array, full (M+1, N+1) shape) — the hook mixed-precision
     refinement (``solvers.refine``) drives. Coefficient canvases come from
@@ -842,7 +859,8 @@ def pallas_cg_solve_rhs(problem: Problem, rhs_grid64, bm: int | None = None,
     rhs_canvas = np.zeros((cv.rows, cv.cols), np.float64)
     rhs_canvas[HALO : HALO + M - 1, cv.cg : cv.cg + N + 1] = scaled[1:M, :]
     rhs = jnp.asarray(rhs_canvas, jnp.dtype(dtype_name))
-    s = _fused_solve(problem, cv, interpret, parallel, cs, cw, g, rhs, sc2)
+    s = _fused_solve(problem, cv, interpret, parallel,
+                     _resolve_serial(serial, parallel), cs, cw, g, rhs, sc2)
     y = s.w[HALO : HALO + M - 1, cv.cg + 1 : cv.cg + N]
     w64 = np.zeros(problem.grid_shape, np.float64)
     w64[1:M, 1:N] = np.asarray(y, np.float64) * np.asarray(
@@ -855,7 +873,8 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
                     interpret: bool | None = None,
                     dtype_name: str = "float32",
                     rhs_gate=None, parallel: bool = False,
-                    bn: int | None = None) -> PCGResult:
+                    bn: int | None = None,
+                    serial: bool | None = None) -> PCGResult:
     """Single-device solve on the fused Pallas path (fp32, scaled system).
 
     A/B counterpart of ``solvers.pcg.pcg_solve(dtype=float32)`` — same
@@ -867,7 +886,9 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
     ``parallel`` marks the tile grid parallel so Mosaic may split it
     across TensorCores (megacore chips) — see :func:`_grid_params`.
     ``bn`` selects the column-blocked canvas (see :class:`Canvas`), for
-    grids too wide for a sane full-width strip height.
+    grids too wide for a sane full-width strip height. ``serial`` selects
+    the reduction-partial layout (None = the ``POISSON_TPU_SERIAL_REDUCE``
+    env default; see the module constant).
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -876,7 +897,8 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
     )
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
-    s = _fused_solve(problem, cv, interpret, parallel, cs, cw, g, rhs, sc2)
+    s = _fused_solve(problem, cv, interpret, parallel,
+                     _resolve_serial(serial, parallel), cs, cw, g, rhs, sc2)
     # Canvas → full-grid solution, unscaled: w = sc · y.
     M, N = problem.M, problem.N
     y = s.w[HALO : HALO + M - 1, cv.cg + 1 : cv.cg + N]
@@ -898,13 +920,13 @@ def pallas_cg_solve(problem: Problem, bm: int | None = None,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _fused_chunk(problem: Problem, cv: Canvas, interpret: bool, chunk: int,
-                 parallel: bool,
+                 parallel: bool, serial: bool,
                  cs, cw, g, sc2, s: _FusedState) -> _FusedState:
     """Advance the fused solve by at most ``chunk`` iterations."""
     body = _make_fused_body(problem, cv, interpret, cs, cw, g, sc2,
-                            s.r.dtype, parallel)
+                            s.r.dtype, parallel, serial)
     stop_at = jnp.minimum(s.k + chunk, problem.iteration_cap)
 
     def cond(st: _FusedState):
@@ -969,7 +991,8 @@ def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                                  interpret: bool | None = None,
                                  keep_checkpoint: bool = False,
                                  parallel: bool = False,
-                                 bn: int | None = None) -> PCGResult:
+                                 bn: int | None = None,
+                                 serial: bool | None = None) -> PCGResult:
     """Fused-path solve with periodic state persistence and automatic
     resume — interoperable with the XLA fp32-scaled checkpoints (module
     comment above). fp32 only, like the fused path itself. The portable
@@ -977,6 +1000,7 @@ def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     auto- or explicitly column-blocked) saves and resumes the same file."""
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
+    serial = _resolve_serial(serial, parallel)
     from poisson_tpu.solvers.checkpoint import (
         _fingerprint,
         load_state,
@@ -1000,7 +1024,7 @@ def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     s = run_chunked(
         s,
         advance=lambda st: _fused_chunk(problem, cv, interpret, chunk,
-                                        parallel, cs, cw, g, sc2, st),
+                                        parallel, serial, cs, cw, g, sc2, st),
         to_portable=lambda st: _fused_to_pcg_state(problem, cv, st),
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint,

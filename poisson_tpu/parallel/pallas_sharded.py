@@ -56,6 +56,7 @@ from poisson_tpu.ops.pallas_cg import (
     LANE,
     SUBLANE,
     Canvas,
+    _resolve_serial,
     direction_and_stencil,
     fused_update,
     diagonal_residual_canvas,
@@ -191,7 +192,7 @@ def _exchange_r_halo(r, spec: ShardSpec, px: int, py: int):
 
 def _make_shard_body(problem: Problem, spec: ShardSpec, px: int, py: int,
                      interpret: bool, cs, cw, g, sc2, colmask, dtype,
-                     parallel: bool = False):
+                     parallel: bool = False, serial: bool = False):
     """One fused sharded iteration as a pure state→state function — shared
     by the convergence while_loop and the chunked checkpointed solve."""
     cv = spec.cv
@@ -207,7 +208,7 @@ def _make_shard_body(problem: Problem, spec: ShardSpec, px: int, py: int,
         beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
         pn, ap, denom_part = direction_and_stencil(
             cv, beta, s.r, s.p, cs, cw, g, interpret=interpret,
-            band=band, colmask=colmask, parallel=parallel,
+            band=band, colmask=colmask, parallel=parallel, serial=serial,
         )
         # Halo rows of the new direction: identical to what the row
         # neighbour computed for its own edge (z = r and old-p halos are
@@ -225,7 +226,7 @@ def _make_shard_body(problem: Problem, spec: ShardSpec, px: int, py: int,
 
         w, r, diff_part, zr_part = fused_update(
             cv, alpha, pn, ap, sc2, s.w, s.r, interpret=interpret,
-            colmask=colmask, parallel=parallel,
+            colmask=colmask, parallel=parallel, serial=serial,
         )
         diff = jnp.abs(alpha32) * jnp.sqrt(psum(jnp.sum(diff_part)) * norm_w)
         zr_new = psum(jnp.sum(zr_part)) * h1h2
@@ -266,10 +267,11 @@ def _shard_init(problem: Problem, spec: ShardSpec, rhs, colmask) -> _State:
 
 def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
                interpret: bool, cs, cw, g, rhs, sc2, sc_int, colmask,
-               parallel: bool = False):
+               parallel: bool = False, serial: bool = False):
     lo, hi = HALO, HALO + spec.m_blk
     body = _make_shard_body(problem, spec, px, py, interpret,
-                            cs, cw, g, sc2, colmask, rhs.dtype, parallel)
+                            cs, cw, g, sc2, colmask, rhs.dtype, parallel,
+                            serial)
 
     def cond(s: _State):
         return (~s.done) & (s.k < problem.iteration_cap)
@@ -279,10 +281,10 @@ def _run_shard(problem: Problem, spec: ShardSpec, px: int, py: int,
     return w_own, s.k, s.diff, s.zr
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 11))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 11, 12))
 def _solve(problem: Problem, mesh: Mesh, spec: ShardSpec, interpret: bool,
            cs, cw, g, rhs, sc2, sc_int, colmask,
-           parallel: bool = False) -> PCGResult:
+           parallel: bool = False, serial: bool = False) -> PCGResult:
     px = mesh.shape[X_AXIS]
     py = mesh.shape[Y_AXIS]
 
@@ -290,7 +292,7 @@ def _solve(problem: Problem, mesh: Mesh, spec: ShardSpec, interpret: bool,
         return _run_shard(
             problem, spec, px, py, interpret,
             cs_b[0], cw_b[0], g_b[0], rhs_b[0], sc2_b[0], sc_int_b[0],
-            colmask_b, parallel,
+            colmask_b, parallel, serial,
         )
 
     stacked = P((X_AXIS, Y_AXIS))
@@ -310,7 +312,8 @@ def pallas_cg_solve_sharded(problem: Problem, mesh: Mesh,
                             interpret: bool | None = None,
                             dtype_name: str = "float32",
                             rhs_gate=None,
-                            parallel: bool = False) -> PCGResult:
+                            parallel: bool = False,
+                            serial: bool | None = None) -> PCGResult:
     """Distributed solve on the fused Pallas path (fp32, scaled system).
 
     The stage4-equivalent configuration: per-shard fused kernels + mesh
@@ -330,7 +333,8 @@ def pallas_cg_solve_sharded(problem: Problem, mesh: Mesh,
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     return _solve(problem, mesh, spec, interpret,
-                  cs, cw, g, rhs, sc2, sc_int, colmask, parallel)
+                  cs, cw, g, rhs, sc2, sc_int, colmask, parallel,
+                  _resolve_serial(serial, parallel))
 
 
 # ---------------------------------------------------------------------------
@@ -387,9 +391,9 @@ def _scatter_canvases(problem: Problem, spec: ShardSpec, px: int, py: int,
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
 def _chunk_solve(problem: Problem, mesh: Mesh, spec: ShardSpec,
-                 interpret: bool, chunk: int, parallel: bool,
+                 interpret: bool, chunk: int, parallel: bool, serial: bool,
                  cs, cw, g, sc2, colmask,
                  w_st, r_st, p_st, k, done, zr, beta, diff):
     px = mesh.shape[X_AXIS]
@@ -399,7 +403,7 @@ def _chunk_solve(problem: Problem, mesh: Mesh, spec: ShardSpec,
                  w_b, r_b, p_b, k, done, zr, beta, diff):
         body = _make_shard_body(problem, spec, px, py, interpret,
                                 cs_b[0], cw_b[0], g_b[0], sc2_b[0],
-                                colmask_b, w_b.dtype, parallel)
+                                colmask_b, w_b.dtype, parallel, serial)
         # Refresh halo rings (resume reconstructs them as zeros; for
         # in-memory state the exchange is value-idempotent).
         r = _exchange_r_halo(r_b[0], spec, px, py)
@@ -464,13 +468,15 @@ def pallas_cg_solve_sharded_checkpointed(
         chunk: int = 200, bm: int | None = None,
         interpret: bool | None = None,
         keep_checkpoint: bool = False,
-        parallel: bool = False) -> PCGResult:
+        parallel: bool = False,
+        serial: bool | None = None) -> PCGResult:
     """Distributed fused-path solve with periodic state persistence and
     automatic resume (portable format — see module comment). fp32 only.
     Multi-process meshes: state is gathered to every process before the
     primary-only write, with barrier-ordered file handoff."""
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
+    serial = _resolve_serial(serial, parallel)
     from poisson_tpu.parallel.checkpoint_sharded import (
         _global_array,
         _multiprocess,
@@ -550,7 +556,7 @@ def pallas_cg_solve_sharded_checkpointed(
     state = run_chunked(
         state,
         advance=lambda s: _CkptState(*_chunk_solve(
-            problem, mesh, spec, interpret, chunk, parallel,
+            problem, mesh, spec, interpret, chunk, parallel, serial,
             cs, cw, g, sc2, colmask,
             s.w, s.r, s.p, s.k, s.done, s.zr, s.beta, s.diff,
         )),

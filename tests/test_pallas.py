@@ -103,7 +103,7 @@ def test_degenerate_direction_stops_cleanly():
     p = Problem(M=16, N=16, max_iter=5)
     cv, cs, cw, g, rhs, sc2, sc64 = build_canvases(p, 8)
     s = pallas_cg._fused_solve(
-        p, cv, True, False, cs, cw, g, jnp.zeros_like(rhs), sc2
+        p, cv, True, False, False, cs, cw, g, jnp.zeros_like(rhs), sc2
     )
     assert int(s.k) == 1
     assert bool(s.done)
@@ -279,3 +279,18 @@ print(json.dumps(out))
     assert got["blocked"][0] == 546
     assert got["sharded_2x2"][0] == 50
     assert got["single"][1] < 4e-4 and got["blocked"][1] < 4e-4
+
+
+def test_serial_reduce_param_in_process():
+    """The threaded ``serial`` knob: in-process A/B against the default
+    layout (distinct jit keys), and the contradictory serial+parallel
+    combination raises instead of silently preferring one."""
+    p = Problem(M=40, N=40)
+    r_def = pallas_cg_solve(p, serial=False)   # explicit: env could say 1
+    r_ser = pallas_cg_solve(p, serial=True)
+    assert int(r_ser.iterations) == int(r_def.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(r_ser.w), np.asarray(r_def.w), rtol=0, atol=5e-6
+    )
+    with pytest.raises(ValueError, match="parallel"):
+        pallas_cg_solve(p, serial=True, parallel=True)
