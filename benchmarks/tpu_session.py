@@ -60,7 +60,15 @@ class Session:
 
     def run(self, step: str, argv: list[str], timeout: float,
             parse_json_tail: bool = False) -> dict | None:
-        """Run a subprocess step; record rc/output; never raise."""
+        """Run a subprocess step; record rc/output; never raise.
+
+        Failures return a dict with ``ok: False`` that distinguishes a
+        timeout (``timeout: True`` — usually a tunnel statement) from a
+        nonzero exit (``rc`` — an in-process verdict, e.g. a
+        libtpu/Mosaic abort, with stderr recorded); callers that need to
+        attribute blame (the kernel-layout gate) rely on the difference.
+        ``None`` is only returned when a zero-exit step produced no
+        parseable JSON tail."""
         try:
             proc = subprocess.run(
                 argv, cwd=_ROOT, env=dict(os.environ), text=True,
@@ -68,14 +76,14 @@ class Session:
             )
         except subprocess.TimeoutExpired:
             self.record(step, {"ok": False, "error": f"timeout>{timeout:.0f}s"})
-            return None
+            return {"ok": False, "timeout": True}
         out = proc.stdout.strip()
         if proc.returncode != 0:
             self.record(step, {
                 "ok": False, "rc": proc.returncode,
                 "stderr": proc.stderr[-1500:], "stdout": out[-500:],
             })
-            return None
+            return {"ok": False, "rc": proc.returncode}
         payload: dict = {"ok": True}
         parsed = None
         if parse_json_tail and out:
@@ -126,6 +134,69 @@ try:
 except Exception as e:
     import traceback
     out.update(ok=False, error=traceback.format_exc()[-1800:])
+print(json.dumps(out))
+"""
+
+
+_CA_PROBE = r"""
+import json, sys, time, dataclasses
+from poisson_tpu.utils.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+from poisson_tpu.analysis import l2_error_host
+from poisson_tpu.config import Problem
+from poisson_tpu.ops.pallas_ca import ca_cg_solve
+from poisson_tpu.ops.pallas_cg import SERIAL_REDUCE
+from poisson_tpu.utils.timing import fence, mlups
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev.platform
+out = {"backend": "pallas_ca(s=2)", "serial_reduce": SERIAL_REDUCE,
+       "device_kind": dev.device_kind}
+# Each stage guarded: whatever was measured before a failure still lands
+# in the JSON (the session charter: failures recorded, never raised).
+try:
+    # Correctness on the flagship grid: golden count + L2 at the floor.
+    p = Problem(M=800, N=1200)
+    t0 = time.perf_counter()
+    res = ca_cg_solve(p)
+    fence(res.iterations)
+    out.update(ok=True, flagship_iters=int(res.iterations), golden=989,
+               l2=l2_error_host(p, res.w),
+               compile_and_first_s=round(time.perf_counter() - t0, 1))
+    t0 = time.perf_counter()
+    res = ca_cg_solve(p)
+    fence(res.iterations)
+    solve = time.perf_counter() - t0
+    out.update(flagship_solve_s=round(solve, 4),
+               flagship_mlups=round(mlups(p, int(res.iterations), solve), 1))
+except Exception:
+    import traceback
+    out.update(ok=False, error=traceback.format_exc()[-1500:])
+if out.get("ok"):
+    try:
+        # Plateau grid: fixed-iteration slope (convergence disabled), the
+        # traffic-reduction measurement VERDICT r2 #5 asks for.
+        big = Problem(M=2400, N=3200, delta=1e-30, max_iter=200)
+        lo = dataclasses.replace(big, max_iter=50)
+        for q in (lo, big):
+            r = ca_cg_solve(q)
+            fence(r.iterations)
+        t0 = time.perf_counter()
+        r = ca_cg_solve(lo)
+        fence(r.iterations)
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = ca_cg_solve(big)
+        fence(r.iterations)
+        t_hi = time.perf_counter() - t0
+        per_iter = (t_hi - t_lo) / (big.max_iter - lo.max_iter)
+        out.update(big_grid=[2400, 3200],
+                   big_iter_seconds=round(per_iter, 6),
+                   big_mlups=round(2399 * 3199 / per_iter / 1e6, 1))
+    except Exception:
+        import traceback
+        out.update(big_grid_error=traceback.format_exc()[-1200:])
 print(json.dumps(out))
 """
 
@@ -255,13 +326,17 @@ def main() -> int:
     # our env). Produces the layout A/B evidence either way.
     probe = s.run("kernel_probe", [py, "-c", _KERNEL_PROBE],
                   timeout=900, parse_json_tail=True)
-    if probe is None:
+    inconclusive = probe is None or (isinstance(probe, dict)
+                                     and probe.get("timeout"))
+    if inconclusive:
         # Timeout / no result is a tunnel statement, not a kernel one —
         # it must not indict the default layout. One retry; if still
         # inconclusive, keep the default and make no layout claim.
         probe = s.run("kernel_probe_retry", [py, "-c", _KERNEL_PROBE],
                       timeout=900, parse_json_tail=True)
-    if probe is None:
+        inconclusive = probe is None or (isinstance(probe, dict)
+                                         and probe.get("timeout"))
+    if inconclusive:
         s.record("layout_decision", {
             "serial_reduce": False,
             "reason": "default-layout probe inconclusive twice (timeout "
@@ -269,9 +344,14 @@ def main() -> int:
                       "about either layout's hardware health",
         })
     elif not probe.get("ok"):
-        # Definitive in-process verdict against the default layout: an
-        # exception or suspect iteration counts. A/B the serial layout.
-        if "error" in probe:
+        # Definitive in-process verdict against the default layout: a
+        # nonzero exit (Mosaic/libtpu abort — stderr recorded), a Python
+        # exception, or suspect iteration counts. A/B the serial layout.
+        if "rc" in probe:
+            default_verdict = (
+                f"crashed on hardware (rc={probe['rc']}, stderr recorded)"
+            )
+        elif "error" in probe:
             default_verdict = "failed on hardware (exception)"
         else:
             default_verdict = (
@@ -322,6 +402,12 @@ def main() -> int:
             py, "benchmarks/roofline.py", "1600", "2400",
             "--bm", "64,128", "--iters", "200", "--parallel",
         ], timeout=1200, parse_json_tail=True)
+
+    # 3.5 communication-avoiding pair-iteration: golden + L2 on the
+    # flagship grid, fixed-iteration slope at the 2400x3200 plateau (the
+    # algorithmic traffic-reduction A/B for the roofline story).
+    s.run("ca_probe", [py, "-c", _CA_PROBE],
+          timeout=1800, parse_json_tail=True)
 
     # 4. masked sharded kernels on the real chip (1x1 mesh)
     s.run("sharded_1x1_mosaic", [py, "-c", _SHARDED_1X1],

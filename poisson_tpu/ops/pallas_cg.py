@@ -108,10 +108,6 @@ VMEM_BUDGET = 12 * 2 ** 20  # leave headroom under the ~16 MB/core VMEM
 SERIAL_REDUCE = os.environ.get("POISSON_TPU_SERIAL_REDUCE", "0") == "1"
 
 
-def serial_reduce_enabled() -> bool:
-    return SERIAL_REDUCE
-
-
 def _resolve_serial(serial: bool | None, parallel: bool) -> bool:
     """Resolve a ``serial`` knob (None = the env default) against the
     ``parallel`` grid marking. The two are contradictory — serial
@@ -129,13 +125,14 @@ def _resolve_serial(serial: bool | None, parallel: bool) -> bool:
     return serial
 
 
-def strip_height(cols: int, owned_rows: int) -> int:
+def strip_height(cols: int, owned_rows: int, buffers: int = 12) -> int:
     """Strip height for a canvas of ``cols`` columns covering ``owned_rows``
-    interior rows: fills the VMEM budget at ~12 strip-buffers in flight
-    (kernel A: 4 in + 2 out, double-buffered), capped at 128 rows and at
-    the owned band, floored at one sublane granule. Shared by the
-    single-device and sharded canvas geometries."""
-    rows = VMEM_BUDGET // (12 * cols * 4)
+    interior rows: fills the VMEM budget at ``buffers`` strip-buffers in
+    flight (kernel A: 4 in + 2 out, double-buffered → 12; the CA basis
+    sweep holds more), capped at 128 rows and at the owned band, floored
+    at one sublane granule. Shared by the single-device, sharded, and CA
+    canvas geometries."""
+    rows = VMEM_BUDGET // (buffers * cols * 4)
     owned_cap = max(SUBLANE, -(-owned_rows // SUBLANE) * SUBLANE)
     rows = min(rows, 128, owned_cap)
     return max(SUBLANE, (rows // SUBLANE) * SUBLANE)
