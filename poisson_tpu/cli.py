@@ -57,11 +57,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-iter", type=int, default=None,
                    help="iteration cap (default (M-1)(N-1))")
     p.add_argument("--backend",
-                   choices=("auto", "xla", "pallas", "sharded",
+                   choices=("auto", "xla", "pallas", "pallas-ca", "sharded",
                             "pallas-sharded", "native"),
                    default="auto",
                    help="auto: pallas-sharded on >1 TPU, sharded on >1 CPU "
-                        "device, pallas on 1 TPU, else xla")
+                        "device, pallas on 1 TPU, else xla. pallas-ca: the "
+                        "communication-avoiding s=2 pair iteration "
+                        "(single-device, fp32, full-width; opt-in)")
     p.add_argument("--mesh", type=_parse_mesh, default=None, metavar="PXxPY",
                    help="device mesh shape for --backend sharded (default: "
                         "near-square over all devices)")
@@ -82,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--parallel-grid", action="store_true",
                    help="mark the pallas tile grid parallel (megacore "
                         "TensorCore split; pallas backends)")
+    p.add_argument("--serial-reduce", action="store_true",
+                   help="use the serial Kahan-compensated reduction-partial "
+                        "layout in the pallas kernels (default: per-strip "
+                        "partials, tree-summed; also settable process-wide "
+                        "via POISSON_TPU_SERIAL_REDUCE=1)")
     p.add_argument("--unweighted-norm", action="store_true",
                    help="stage0's unweighted convergence norm")
     p.add_argument("--repeat", type=int, default=1,
@@ -193,6 +200,7 @@ def _run_jax(args, problem: Problem, backend: str):
                     "--backend pallas-sharded builds its canvases on the "
                     "host; use --backend sharded for --setup device"
                 )
+            serial = True if args.serial_reduce else None
             if args.checkpoint:
                 from poisson_tpu.parallel import (
                     pallas_cg_solve_sharded_checkpointed,
@@ -200,11 +208,12 @@ def _run_jax(args, problem: Problem, backend: str):
 
                 run = lambda: pallas_cg_solve_sharded_checkpointed(
                     problem, mesh, args.checkpoint, chunk=args.chunk,
-                    bm=args.bm, parallel=args.parallel_grid,
+                    bm=args.bm, parallel=args.parallel_grid, serial=serial,
                 )
             else:
                 run = lambda: pallas_cg_solve_sharded(
-                    problem, mesh, bm=args.bm, parallel=args.parallel_grid
+                    problem, mesh, bm=args.bm,
+                    parallel=args.parallel_grid, serial=serial,
                 )
         elif args.checkpoint:
             if args.setup == "device":
@@ -223,25 +232,44 @@ def _run_jax(args, problem: Problem, backend: str):
                 problem, mesh, dtype=args.dtype, setup=args.setup
             )
         n_dev = mesh_shape[0] * mesh_shape[1]
+    elif backend == "pallas-ca":
+        if args.dtype == "float64":
+            raise SystemExit(
+                "--backend pallas-ca is the fp32 fused path; use --backend "
+                "xla for float64"
+            )
+        if args.checkpoint:
+            raise SystemExit(
+                "--backend pallas-ca has no checkpointed driver yet; use "
+                "--backend pallas"
+            )
+        from poisson_tpu.ops.pallas_ca import ca_cg_solve
+
+        run = lambda: ca_cg_solve(
+            problem, bm=args.bm, parallel=args.parallel_grid,
+            serial=(True if args.serial_reduce else None),
+        )
+        n_dev = 1
     elif backend == "pallas":
         if args.dtype == "float64":
             raise SystemExit(
                 "--backend pallas is the fp32 fused path; use --backend xla "
                 "for float64"
             )
+        serial = True if args.serial_reduce else None
         if args.checkpoint:
             from poisson_tpu.ops.pallas_cg import pallas_cg_solve_checkpointed
 
             run = lambda: pallas_cg_solve_checkpointed(
                 problem, args.checkpoint, chunk=args.chunk, bm=args.bm,
-                parallel=args.parallel_grid, bn=args.bn,
+                parallel=args.parallel_grid, bn=args.bn, serial=serial,
             )
         else:
             from poisson_tpu.ops.pallas_cg import pallas_cg_solve
 
             run = lambda: pallas_cg_solve(
                 problem, bm=args.bm, bn=args.bn,
-                parallel=args.parallel_grid,
+                parallel=args.parallel_grid, serial=serial,
             )
         n_dev = 1
     elif args.checkpoint:
@@ -276,7 +304,7 @@ def _run_jax(args, problem: Problem, backend: str):
 
     dtype_name = (
         "float32"
-        if backend in ("pallas", "pallas-sharded")
+        if backend in ("pallas", "pallas-ca", "pallas-sharded")
         else resolve_dtype(args.dtype)
     )
     report = solve_report(
@@ -366,10 +394,11 @@ def main(argv=None) -> int:
         if args.categories:
             raise SystemExit("--categories times the JAX ops; "
                              "not available with --backend native")
-        if args.bm is not None or args.bn is not None or args.parallel_grid:
+        if (args.bm is not None or args.bn is not None or args.parallel_grid
+                or args.serial_reduce):
             raise SystemExit(
-                "--bm/--bn/--parallel-grid shape the pallas kernels; "
-                "not available with --backend native"
+                "--bm/--bn/--parallel-grid/--serial-reduce shape the pallas "
+                "kernels; not available with --backend native"
             )
         report, timer, w = _run_native(args, problem)
     else:
@@ -381,19 +410,30 @@ def main(argv=None) -> int:
                 f"(resolved backend: {backend})"
             )
         if args.parallel_grid and backend not in (
-            "pallas", "pallas-sharded"
+            "pallas", "pallas-ca", "pallas-sharded"
         ):
             raise SystemExit(
                 f"--parallel-grid applies to the pallas backends "
                 f"(resolved backend: {backend})"
             )
         if args.bm is not None and backend not in (
-            "pallas", "pallas-sharded"
+            "pallas", "pallas-ca", "pallas-sharded"
         ):
             raise SystemExit(
                 f"--bm applies to the pallas backends "
                 f"(resolved backend: {backend})"
             )
+        if args.serial_reduce:
+            if backend not in ("pallas", "pallas-ca", "pallas-sharded"):
+                raise SystemExit(
+                    f"--serial-reduce applies to the pallas backends "
+                    f"(resolved backend: {backend})"
+                )
+            if args.parallel_grid:
+                raise SystemExit(
+                    "--serial-reduce accumulates across sequential grid "
+                    "steps; it cannot be combined with --parallel-grid"
+                )
         report, timer, w = _run_jax(args, problem, backend)
 
     if args.save_solution:

@@ -372,11 +372,12 @@ def _make_ca_body(problem: Problem, cv: Canvas, interpret: bool,
         )
 
         stop1 = deg1 | (diff1 < delta)
+        cap_stop = s.k + 1 >= problem.iteration_cap
         # Apply only the first inner step when: it converged (stop1), the
         # second step is degenerate (deg2 — its α would be garbage), or
         # the iteration cap allows exactly one more step (the 2-sweep
         # path reports iterations == cap exactly; so must this one).
-        only1 = stop1 | deg2 | (s.k + 1 >= problem.iteration_cap)
+        only1 = stop1 | deg2 | cap_stop
         a2 = jnp.where(only1, 0.0, alpha2)
         c_p = alpha1 + a2 * beta1
         coefs = jnp.stack(
@@ -395,14 +396,22 @@ def _make_ca_body(problem: Problem, cv: Canvas, interpret: bool,
         # cap-truncated pair mathematically identical to the 2-sweep
         # path's state at the same k.
         done = stop1 | deg2 | ((~only1) & (diff2 < delta))
+        # k/diff mirror the 2-sweep path exactly, including the (never
+        # observed for this SPD system) degenerate second step: the
+        # 2-sweep loop COUNTS the degenerate iteration with α=0 and
+        # diff=0, so deg2 increments by 2 and reports 0 — only a
+        # converged or cap-truncated first step increments by 1.
+        short = stop1 | cap_stop
         return _CAState(
-            k=s.k + jnp.where(only1, 1, 2).astype(jnp.int32),
+            k=s.k + jnp.where(short, 1, 2).astype(jnp.int32),
             done=done,
             x=x, r=r,
             pprev=jnp.where(only1, pn, p1),
             rr=rr2,
             beta=beta2,
-            diff=jnp.where(only1, diff1, diff2),
+            diff=jnp.where(
+                short, diff1, jnp.where(deg2, jnp.float32(0.0), diff2)
+            ),
         )
 
     return body
