@@ -238,17 +238,21 @@ def _run_jax(args, problem: Problem, backend: str):
                 "--backend pallas-ca is the fp32 fused path; use --backend "
                 "xla for float64"
             )
+        serial = True if args.serial_reduce else None
         if args.checkpoint:
-            raise SystemExit(
-                "--backend pallas-ca has no checkpointed driver yet; use "
-                "--backend pallas"
-            )
-        from poisson_tpu.ops.pallas_ca import ca_cg_solve
+            from poisson_tpu.ops.pallas_ca import ca_cg_solve_checkpointed
 
-        run = lambda: ca_cg_solve(
-            problem, bm=args.bm, parallel=args.parallel_grid,
-            serial=(True if args.serial_reduce else None),
-        )
+            run = lambda: ca_cg_solve_checkpointed(
+                problem, args.checkpoint, chunk=args.chunk, bm=args.bm,
+                parallel=args.parallel_grid, serial=serial,
+            )
+        else:
+            from poisson_tpu.ops.pallas_ca import ca_cg_solve
+
+            run = lambda: ca_cg_solve(
+                problem, bm=args.bm, parallel=args.parallel_grid,
+                serial=serial,
+            )
         n_dev = 1
     elif backend == "pallas":
         if args.dtype == "float64":

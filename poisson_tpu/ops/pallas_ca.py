@@ -417,6 +417,24 @@ def _make_ca_body(problem: Problem, cv: Canvas, interpret: bool,
     return body
 
 
+def _ca_init(problem: Problem, cv: Canvas, rhs) -> _CAState:
+    """x=0, r=b̃, β=0 (the first basis sweep then forms pn ← r + 0 = r₀) —
+    the ONE initial-state recipe, shared by the one-shot and checkpointed
+    drivers so they start from bit-identical states."""
+    zeros = jnp.zeros((cv.rows, cv.cols), rhs.dtype)
+    rr0 = jnp.sum(rhs.astype(jnp.float32) ** 2) * jnp.float32(
+        problem.h1 * problem.h2
+    )
+    return _CAState(
+        k=jnp.zeros((), jnp.int32),
+        done=jnp.asarray(False),
+        x=zeros, r=rhs, pprev=zeros,
+        rr=rr0,
+        beta=jnp.float32(0.0),
+        diff=jnp.float32(jnp.inf),
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _ca_solve(problem: Problem, cv: Canvas, interpret: bool,
               parallel: bool, serial: bool, cs, cw, g, rhs, sc2):
@@ -427,19 +445,92 @@ def _ca_solve(problem: Problem, cv: Canvas, interpret: bool,
     def cond(s: _CAState):
         return (~s.done) & (s.k < problem.iteration_cap)
 
-    zeros = jnp.zeros((cv.rows, cv.cols), dtype)
-    rr0 = jnp.sum(rhs.astype(jnp.float32) ** 2) * jnp.float32(
-        problem.h1 * problem.h2
+    return lax.while_loop(cond, body, _ca_init(problem, cv, rhs))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def _ca_chunk(problem: Problem, cv: Canvas, interpret: bool, chunk: int,
+              parallel: bool, serial: bool,
+              cs, cw, g, sc2, s: _CAState) -> _CAState:
+    """Advance the CA solve by ~``chunk`` iterations (a pair straddling
+    the chunk boundary overshoots by one — chunking must not change the
+    iterate sequence, so only the global cap ever truncates a pair)."""
+    body = _make_ca_body(problem, cv, interpret, cs, cw, g, sc2,
+                         s.r.dtype, parallel, serial)
+    stop_at = jnp.minimum(s.k + chunk, problem.iteration_cap)
+
+    def cond(st: _CAState):
+        return (~st.done) & (st.k < stop_at)
+
+    return lax.while_loop(cond, body, s)
+
+
+def ca_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
+                             chunk: int = 200, bm: int | None = None,
+                             interpret: bool | None = None,
+                             keep_checkpoint: bool = False,
+                             parallel: bool = False,
+                             serial: bool | None = None) -> PCGResult:
+    """CA solve with periodic state persistence and automatic resume.
+
+    Same portable full-grid ``PCGState`` format and (float32, scaled)
+    fingerprint as every other checkpointed solver: the CA state's
+    pending pair (pprev, β) maps to the stored updated direction
+    d = r + β·pprev exactly like the 2-sweep fused path's, so a CA
+    checkpoint resumes on the fused or XLA fp32-scaled paths and vice
+    versa — cross-ALGORITHM resume, not just cross-backend.
+    """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    serial = _resolve_serial(serial, parallel)
+    from poisson_tpu.ops.pallas_cg import (
+        pcg_state_to_pending,
+        pending_to_pcg_state,
     )
-    init = _CAState(
-        k=jnp.zeros((), jnp.int32),
-        done=jnp.asarray(False),
-        x=zeros, r=rhs, pprev=zeros,
-        rr=rr0,
-        beta=jnp.float32(0.0),   # first sweep: pn ← r + 0 = r₀
-        diff=jnp.float32(jnp.inf),
+    from poisson_tpu.solvers.checkpoint import (
+        _fingerprint,
+        load_state,
+        run_chunked,
     )
-    return lax.while_loop(cond, body, init)
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if bm is None:
+        bm = pick_bm_ca(problem)
+    cv, cs, cw, g, rhs, sc2, sc_int = build_canvases(
+        problem, bm, "float32", 0
+    )
+    fp = _fingerprint(problem, "float32", True)
+
+    def to_portable(s: _CAState):
+        return pending_to_pcg_state(
+            problem, cv, k=s.k, done=s.done, sol=s.x, r=s.r, pend=s.pprev,
+            beta=s.beta, zr=s.rr, diff=s.diff,
+        )
+
+    saved = load_state(checkpoint_path, fp)
+    if saved is None:
+        s = _ca_init(problem, cv, rhs)
+    else:
+        f = pcg_state_to_pending(problem, cv, saved)
+        s = _CAState(
+            k=f["k"], done=f["done"], x=f["sol"], r=f["r"],
+            pprev=f["pend"], rr=f["zr"], beta=f["beta"], diff=f["diff"],
+        )
+
+    s = run_chunked(
+        s,
+        advance=lambda st: _ca_chunk(problem, cv, interpret, chunk,
+                                     parallel, serial, cs, cw, g, sc2, st),
+        to_portable=to_portable,
+        path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
+        keep_checkpoint=keep_checkpoint,
+    )
+
+    M, N = problem.M, problem.N
+    y = s.x[HALO : HALO + M - 1, 1:N]
+    w = jnp.pad(y * sc_int, 1)
+    return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.rr)
 
 
 def ca_cg_solve(problem: Problem, bm: int | None = None,

@@ -952,34 +952,61 @@ def _full_to_canvas(problem: Problem, cv: Canvas, full) -> jnp.ndarray:
     return jnp.asarray(c)
 
 
+def pending_to_pcg_state(problem: Problem, cv: Canvas, *, k, done, sol, r,
+                         pend, beta, zr, diff) -> PCGState:
+    """Any pending-β solver state → the portable full-grid PCGState.
+
+    Both the fused 2-sweep loop and the CA pair loop carry the PREVIOUS
+    direction material plus a pending β (applied at the top of their
+    first kernel), while PCGState stores the fully-updated direction
+    d = z + β·p. This one converter owns that mapping (and the z = r
+    convention of the scaled system) for every such solver."""
+    r_host = np.asarray(r)
+    d = r_host + float(beta) * np.asarray(pend)
+    r_full = _canvas_to_full(problem, cv, r_host)
+    return PCGState(
+        k=np.asarray(k), done=np.asarray(done),
+        w=_canvas_to_full(problem, cv, sol), r=r_full, z=r_full,
+        p=_canvas_to_full(problem, cv, d),
+        zr=np.asarray(zr), diff=np.asarray(diff),
+    )
+
+
+def pcg_state_to_pending(problem: Problem, cv: Canvas,
+                         state: PCGState) -> dict:
+    """Portable PCGState → pending-β canvases: pend := d − r with β := 1
+    (then r + 1·(d − r) = d, exact to one ulp per element). Returned as a
+    dict so each solver builds its own state type from it."""
+    d = np.asarray(state.p, np.float32)
+    r = np.asarray(state.r, np.float32)
+    return dict(
+        k=jnp.asarray(state.k, jnp.int32),
+        done=jnp.asarray(np.asarray(state.done), bool),
+        sol=_full_to_canvas(problem, cv, np.asarray(state.w, np.float32)),
+        r=_full_to_canvas(problem, cv, r),
+        pend=_full_to_canvas(problem, cv, d - r),
+        zr=jnp.asarray(np.asarray(state.zr), jnp.float32),
+        beta=jnp.float32(1.0),
+        diff=jnp.asarray(np.asarray(state.diff), jnp.float32),
+    )
+
+
 def _fused_to_pcg_state(problem: Problem, cv: Canvas,
                         s: _FusedState) -> PCGState:
     """Fused state → the portable full-grid PCGState (y-space, z = r)."""
-    r = np.asarray(s.r)
-    d = r + float(s.beta) * np.asarray(s.p)   # updated direction z + β·p
-    r_full = _canvas_to_full(problem, cv, s.r)
-    return PCGState(
-        k=np.asarray(s.k), done=np.asarray(s.done),
-        w=_canvas_to_full(problem, cv, s.w), r=r_full, z=r_full,
-        p=_canvas_to_full(problem, cv, d),
-        zr=np.asarray(s.zr), diff=np.asarray(s.diff),
+    return pending_to_pcg_state(
+        problem, cv, k=s.k, done=s.done, sol=s.w, r=s.r, pend=s.p,
+        beta=s.beta, zr=s.zr, diff=s.diff,
     )
 
 
 def _pcg_state_to_fused(problem: Problem, cv: Canvas,
                         state: PCGState) -> _FusedState:
     """Portable PCGState → fused state: p := d − r with β := 1."""
-    d = np.asarray(state.p, np.float32)
-    r = np.asarray(state.r, np.float32)
+    f = pcg_state_to_pending(problem, cv, state)
     return _FusedState(
-        k=jnp.asarray(state.k, jnp.int32),
-        done=jnp.asarray(np.asarray(state.done), bool),
-        w=_full_to_canvas(problem, cv, np.asarray(state.w, np.float32)),
-        r=_full_to_canvas(problem, cv, r),
-        p=_full_to_canvas(problem, cv, d - r),
-        zr=jnp.asarray(np.asarray(state.zr), jnp.float32),
-        beta=jnp.float32(1.0),
-        diff=jnp.asarray(np.asarray(state.diff), jnp.float32),
+        k=f["k"], done=f["done"], w=f["sol"], r=f["r"], p=f["pend"],
+        zr=f["zr"], beta=f["beta"], diff=f["diff"],
     )
 
 

@@ -101,3 +101,38 @@ def test_gate_is_bit_exact():
     r2 = ca_cg_solve(p, rhs_gate=jnp.float32(1.0))
     assert int(r1.iterations) == int(r2.iterations)
     assert np.array_equal(np.asarray(r1.w), np.asarray(r2.w))
+
+
+def test_checkpoint_resume_and_cross_algorithm(tmp_path):
+    """CA checkpoints use the shared portable PCGState format: a solve
+    interrupted mid-run resumes to the identical result, and a CA
+    checkpoint resumes on the 2-sweep fused path (cross-ALGORITHM, the
+    strongest portability claim the format makes)."""
+    import dataclasses
+
+    from poisson_tpu.ops.pallas_ca import ca_cg_solve_checkpointed
+    from poisson_tpu.ops.pallas_cg import pallas_cg_solve_checkpointed
+
+    p = Problem(M=40, N=40)
+    one_shot = ca_cg_solve(p)
+
+    # Interrupt at 20 iterations (cap), then resume to convergence.
+    ck = str(tmp_path / "ck.npz")
+    capped = dataclasses.replace(p, max_iter=20)
+    part = ca_cg_solve_checkpointed(capped, ck, chunk=7,
+                                    keep_checkpoint=True)
+    assert int(part.iterations) == 20
+    resumed = ca_cg_solve_checkpointed(p, ck, chunk=7)
+    assert int(resumed.iterations) == int(one_shot.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(resumed.w), np.asarray(one_shot.w), atol=2e-6
+    )
+
+    # Cross-algorithm: CA checkpoint -> 2-sweep fused resume.
+    ck2 = str(tmp_path / "ck2.npz")
+    ca_cg_solve_checkpointed(capped, ck2, chunk=7, keep_checkpoint=True)
+    crossed = pallas_cg_solve_checkpointed(p, ck2, chunk=7)
+    assert int(crossed.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(crossed.w), np.asarray(one_shot.w), atol=2e-6
+    )
