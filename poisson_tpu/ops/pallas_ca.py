@@ -197,7 +197,7 @@ def _make_basis_kernel(cv: Canvas, serial: bool):
                 gram_ref[0, j] = t
         else:
             for j, val in enumerate(sums):
-                gram_ref[0, j] = val
+                gram_ref[i, j] = val
 
     return kernel
 
@@ -228,21 +228,24 @@ def _make_pair_update_kernel(cv: Canvas, serial: bool):
         if serial:
             _kahan_add(pl.program_id(0) == 0, rr_ref, comp_ref, 0, part)
         else:
-            rr_ref[0, 0] = part
+            rr_ref[pl.program_id(0), 0] = part
 
     return kernel
 
 
 def _gram_out_spec(serial: bool, nb: int):
+    # Both variants are whole-array SMEM windows: Mosaic exempts only
+    # trivial-window SMEM blocks from its (8, 128) tiling rules, so the
+    # per-row ``(1, N_GRAM) @ (i, 0)`` map this replaces lowered only
+    # when nb == 1 (see ops.pallas_cg._partial_out_spec — the round-3
+    # hardware-failure class). Strip i writes row i in-kernel.
     if serial:
         return (
-            pl.BlockSpec((1, N_GRAM), lambda i: (0, 0),
-                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             jax.ShapeDtypeStruct((1, N_GRAM), jnp.float32),
         )
     return (
-        pl.BlockSpec((1, N_GRAM), lambda i: (i, 0),
-                     memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
         jax.ShapeDtypeStruct((nb, N_GRAM), jnp.float32),
     )
 
@@ -289,11 +292,10 @@ def pair_update(cv: Canvas, coefs, pn, t1, t2, t3, x, r, *,
                 serial: bool | None = None):
     """x', r', p₁, Σr'² partials — one HBM sweep (kernel D)."""
     serial = _resolve_serial(serial, parallel)
-    rr_spec = (
-        pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
-        if serial else
-        pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM)
-    )
+    # Whole-array SMEM windows (strip i writes its own cell in-kernel;
+    # see _gram_out_spec / ops.pallas_cg._partial_out_spec for why the
+    # per-cell block maps they replace could not lower for nb > 1).
+    rr_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     rr_shape = jax.ShapeDtypeStruct((1, 1) if serial else (cv.nb, 1),
                                     jnp.float32)
     coef_spec = pl.BlockSpec((1, 8), lambda i: (0, 0),

@@ -393,8 +393,9 @@ def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
         apc = ap * c
         if masked:
             apc = apc * colmask_ref[:]
-        # Per-strip partial only: strip i owns row i of an (nb, 1) output and
-        # XLA tree-sums the partials outside the kernel. A single SMEM scalar
+        # Per-strip partial only: strip i owns row i of the (nb, 1) output
+        # (whole-array SMEM window; see _partial_out_spec) and XLA
+        # tree-sums the partials outside the kernel. A single SMEM scalar
         # accumulated across strips rounds serially (nb-long dependence
         # chain), which cost 6× in L2 accuracy at 2400×3200 — the serial
         # variant compensates with a Kahan scratch cell instead.
@@ -402,7 +403,7 @@ def _make_direction_stencil_kernel(cv: Canvas, band: tuple[int, int],
         if serial:
             _kahan_add(i == 0, denom_ref, comp_ref, 0, part)
         else:
-            denom_ref[0, 0] = part
+            denom_ref[i, 0] = part
 
     return kernel
 
@@ -474,13 +475,14 @@ def _make_blocked_stencil_kernel(cv: Canvas, band: tuple[int, int],
         )
         pn_ref[:] = c
         ap_ref[:] = ap
-        # Per-tile partial (row i, col j of an (nb, ncb) output); the
-        # caller tree-sums, same accuracy rationale as the strip partials.
+        # Per-tile partial (cell (i, j) of the whole-window (nb, ncb)
+        # output; see _partial_out_spec); the caller tree-sums, same
+        # accuracy rationale as the strip partials.
         part = jnp.sum(ap * c, dtype=jnp.float32)
         if serial:
             _kahan_add(_is_first_step(2), denom_ref, scratch[0], 0, part)
         else:
-            denom_ref[0, 0] = part
+            denom_ref[i, j] = part
 
     return kernel
 
@@ -509,7 +511,8 @@ def _make_update_kernel(masked: bool, serial: bool = False, ndims: int = 1):
         rr = r_new * r_new
         if masked:
             rr = rr * colmask_ref[:]
-        # Per-strip partials (see kernel A): row i of the (nb, 1) outputs.
+        # Per-strip partials (see kernel A): cell (i[, j]) of the
+        # whole-window (nb[, ncb]) outputs.
         d_part = jnp.sum(p * p * sc2_ref[:], dtype=jnp.float32)
         z_part = jnp.sum(rr, dtype=jnp.float32)
         if serial:
@@ -517,8 +520,10 @@ def _make_update_kernel(masked: bool, serial: bool = False, ndims: int = 1):
             _kahan_add(first, diff_ref, comp_ref, 0, d_part)
             _kahan_add(first, zr_ref, comp_ref, 1, z_part)
         else:
-            diff_ref[0, 0] = d_part
-            zr_ref[0, 0] = z_part
+            i = pl.program_id(0)
+            j = pl.program_id(1) if ndims == 2 else 0
+            diff_ref[i, j] = d_part
+            zr_ref[i, j] = z_part
 
     return kernel
 
@@ -550,10 +555,22 @@ def _scalar_spec():
 
 
 def _partial_out_spec():
-    """Row i of an (nb, 1) SMEM output: each strip's reduction partial.
-    XLA tree-sums the partials after the kernel — a serial SMEM accumulator
-    across strips loses ~6× L2 accuracy at the largest published grid."""
-    return pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM)
+    """The whole (nb, 1) SMEM output as one trivial window: each strip
+    writes its reduction partial to row ``program_id(0)`` in-kernel, and
+    XLA tree-sums the partials after the kernel — a serial SMEM
+    accumulator across strips loses ~6× L2 accuracy at the largest
+    published grid.
+
+    Why trivial-window: Mosaic requires blocked specs' last two dims be
+    multiples of (8, 128) or equal to the array dims, so the per-cell
+    ``(1, 1) @ (i, 0)`` mapping this replaces lowered ONLY when nb == 1 —
+    tiny grids passed while every real geometry crashed at lowering on
+    the chip (the round-3 on-hardware failure; reproduced off-chip by
+    tests/test_mosaic_lowering.py). SMEM blocks with a trivial window are
+    exempt from the tiling rules, and SMEM supports dynamic scalar
+    stores, so the whole-array window with in-kernel indexing expresses
+    the identical layout legally."""
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
 def _canvas_shape(cv: Canvas, dtype):
@@ -585,8 +602,9 @@ def _blk_specs(cv: Canvas):
     )
     scalar = pl.BlockSpec((1, 1), lambda i, j: (0, 0),
                           memory_space=pltpu.SMEM)
-    partial = pl.BlockSpec((1, 1), lambda i, j: (i, j),
-                           memory_space=pltpu.SMEM)
+    # Whole (nb, ncb) SMEM window; tile (i, j) writes its own cell
+    # in-kernel (see _partial_out_spec for why not a per-cell block map).
+    partial = pl.BlockSpec(memory_space=pltpu.SMEM)
     return strip, cs, cw, block, scalar, partial
 
 
@@ -598,9 +616,15 @@ def _colmask_spec(cv: Canvas):
 def _grid_params(parallel: bool, ndims: int = 1):
     """Grid-dimension semantics. ``parallel`` lets Mosaic distribute the
     tile loop across TensorCores (megacore): every tile writes disjoint
-    center blocks and its own partial-output cell, so the grid is
-    parallel-safe by construction. Off by default — it must earn its place
-    on hardware (BENCH.md) before becoming the default."""
+    center blocks and a distinct cell of the shared whole-window partial
+    output. CAVEAT: the partial outputs are one SMEM window shared by all
+    grid steps (the only Mosaic-lowerable expression of the layout — see
+    _partial_out_spec), and whether megacore write-back merges distinct
+    cells written by different cores is UNVERIFIED — the target v5e has a
+    single TensorCore, where the question cannot arise. Off by default —
+    it must earn its place on hardware (BENCH.md) before becoming the
+    default, and on a megacore chip the reduction values need explicit
+    validation first (the golden iteration counts catch corruption)."""
     if not parallel:
         return {}
     return {
