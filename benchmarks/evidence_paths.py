@@ -1,0 +1,16 @@
+"""Canonical locations of cross-process TPU evidence artifacts.
+
+The layout-decision artifact is a contract between two processes that
+must never drift apart: ``benchmarks/tpu_session.py`` writes the kernel
+reduction-layout verdict after its hardware A/B gate, and ``bench.py``
+(the driver entry point) adopts it into the import-frozen
+``POISSON_TPU_SERIAL_REDUCE`` env knob before touching any kernel module.
+Both sides import the path from here.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+RESULTS_DIR = pathlib.Path(__file__).resolve().parent / "results"
+LAYOUT_DECISION_PATH = RESULTS_DIR / "layout_decision.json"

@@ -1,0 +1,67 @@
+#!/bin/bash
+# Tunnel watch loop: probe the axon TPU tunnel every ~2 min and pounce on
+# the first healthy window with the one-shot evidence session.
+#
+# Discipline (see round-3 postmortem): exactly ONE TPU client at a time.
+# A watch-lifetime pidfile makes the whole loop single-instance — probes
+# are TPU clients too, so a second concurrent watch is a wedge risk even
+# between sessions. The session is launched at most once per healthy
+# window; any nonzero session exit (identity gate failed, or the wedge
+# defense aborted mid-run) re-arms the launch so the session resumes when
+# the wedge clears (remove $RESULTS/session_launched to re-arm manually).
+# After ONE clean session the watch exits — evidence captured, stop
+# touching the tunnel.
+cd /root/repo || exit 1
+RESULTS=benchmarks/results
+mkdir -p "$RESULTS"
+PIDFILE=$RESULTS/tunnel_watch.pid
+if [ -f "$PIDFILE" ]; then
+  owner=$(cat "$PIDFILE" 2>/dev/null)
+  if [ -n "$owner" ] && kill -0 "$owner" 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) watch already running (pid $owner); exiting" \
+      >> "$RESULTS/tunnel_probe.log"
+    exit 0
+  fi
+fi
+echo "$$" > "$PIDFILE"
+trap 'rm -f "$PIDFILE"' EXIT
+# Matches tpu_session.py's _utc() format so --resume-after compares
+# lexicographically against session.jsonl "at" stamps; only steps this
+# watch generation completed may satisfy a resumed session.
+WATCH_START=$(date -u +%FT%T+00:00)
+RESUME_ARGS=""
+echo "$(date -u +%FT%TZ) watch started (pid $$)" >> "$RESULTS/tunnel_probe.log"
+while true; do
+  TS=$(date -u +%FT%TZ)
+  if timeout 90 python -c "
+from poisson_tpu.utils.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+assert jax.devices()[0].platform == 'tpu'
+" >/dev/null 2>&1; then
+    echo "$TS healthy" >> "$RESULTS/tunnel_probe.log"
+    if [ ! -f "$RESULTS/session_launched" ]; then
+      touch "$RESULTS/session_launched"
+      echo "$TS launching tpu_session.py $RESUME_ARGS" >> "$RESULTS/tunnel_probe.log"
+      # shellcheck disable=SC2086
+      python benchmarks/tpu_session.py $RESUME_ARGS >> "$RESULTS/tpu_session_stdout.log" 2>&1
+      rc=$?
+      echo "$(date -u +%FT%TZ) session exited rc=$rc" >> "$RESULTS/tunnel_probe.log"
+      if [ "$rc" = "0" ]; then
+        # Clean session: evidence captured; stop being a tunnel client.
+        echo "$(date -u +%FT%TZ) watch done (clean session)" >> "$RESULTS/tunnel_probe.log"
+        exit 0
+      fi
+      # Identity-gate failure or wedge-defense abort: re-arm so the
+      # session resumes when the wedge clears (cool down first; wedges
+      # last tens of minutes). The relaunch replays steps this watch
+      # generation already completed instead of re-running them.
+      rm -f "$RESULTS/session_launched"
+      RESUME_ARGS="--resume-after $WATCH_START"
+      sleep 600
+    fi
+  else
+    echo "$TS wedged" >> "$RESULTS/tunnel_probe.log"
+  fi
+  sleep 120
+done
