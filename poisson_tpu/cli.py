@@ -84,11 +84,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--parallel-grid", action="store_true",
                    help="mark the pallas tile grid parallel (megacore "
                         "TensorCore split; pallas backends)")
-    p.add_argument("--serial-reduce", action="store_true",
-                   help="use the serial Kahan-compensated reduction-partial "
-                        "layout in the pallas kernels (default: per-strip "
-                        "partials, tree-summed; also settable process-wide "
-                        "via POISSON_TPU_SERIAL_REDUCE=1)")
+    p.add_argument("--serial-reduce", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="reduction-partial layout in the pallas kernels: "
+                        "--serial-reduce selects the serial "
+                        "Kahan-compensated layout, --no-serial-reduce the "
+                        "per-strip tree-summed partials. Tri-state so the "
+                        "CLI can override the POISSON_TPU_SERIAL_REDUCE "
+                        "env default in BOTH directions (unset: the env "
+                        "default, which is per-strip partials)")
     p.add_argument("--unweighted-norm", action="store_true",
                    help="stage0's unweighted convergence norm")
     p.add_argument("--repeat", type=int, default=1,
@@ -200,7 +204,7 @@ def _run_jax(args, problem: Problem, backend: str):
                     "--backend pallas-sharded builds its canvases on the "
                     "host; use --backend sharded for --setup device"
                 )
-            serial = True if args.serial_reduce else None
+            serial = args.serial_reduce
             if args.checkpoint:
                 from poisson_tpu.parallel import (
                     pallas_cg_solve_sharded_checkpointed,
@@ -238,7 +242,7 @@ def _run_jax(args, problem: Problem, backend: str):
                 "--backend pallas-ca is the fp32 fused path; use --backend "
                 "xla for float64"
             )
-        serial = True if args.serial_reduce else None
+        serial = args.serial_reduce
         if args.checkpoint:
             from poisson_tpu.ops.pallas_ca import ca_cg_solve_checkpointed
 
@@ -260,7 +264,7 @@ def _run_jax(args, problem: Problem, backend: str):
                 "--backend pallas is the fp32 fused path; use --backend xla "
                 "for float64"
             )
-        serial = True if args.serial_reduce else None
+        serial = args.serial_reduce
         if args.checkpoint:
             from poisson_tpu.ops.pallas_cg import pallas_cg_solve_checkpointed
 
@@ -399,7 +403,7 @@ def main(argv=None) -> int:
             raise SystemExit("--categories times the JAX ops; "
                              "not available with --backend native")
         if (args.bm is not None or args.bn is not None or args.parallel_grid
-                or args.serial_reduce):
+                or args.serial_reduce is not None):
             raise SystemExit(
                 "--bm/--bn/--parallel-grid/--serial-reduce shape the pallas "
                 "kernels; not available with --backend native"
@@ -427,13 +431,13 @@ def main(argv=None) -> int:
                 f"--bm applies to the pallas backends "
                 f"(resolved backend: {backend})"
             )
-        if args.serial_reduce:
+        if args.serial_reduce is not None:
             if backend not in ("pallas", "pallas-ca", "pallas-sharded"):
                 raise SystemExit(
-                    f"--serial-reduce applies to the pallas backends "
-                    f"(resolved backend: {backend})"
+                    f"--serial-reduce/--no-serial-reduce applies to the "
+                    f"pallas backends (resolved backend: {backend})"
                 )
-            if args.parallel_grid:
+            if args.serial_reduce and args.parallel_grid:
                 raise SystemExit(
                     "--serial-reduce accumulates across sequential grid "
                     "steps; it cannot be combined with --parallel-grid"

@@ -9,7 +9,8 @@ per-iteration structure, one stencil + three reductions,
 ``stage4-mpi+cuda/poisson_mpi_cuda_f.cu:847-941``, has no such headroom
 either). This module restructures TWO CG iterations into TWO sweeps:
 
-  kernel C (basis sweep), one pass over 6 strip-read arrays:
+  kernel C (basis sweep), one pass over 5 strip-read arrays (p_prev, r,
+  cs, cw, g) plus the center-only sc² block:
       pn  ← r + β·p_prev          (the pending direction update, exactly
                                    kernel A's fused form)
       t1  ← Ã pn                  (computed on center±1 rows in-register)
@@ -45,8 +46,10 @@ iterations ≈ 10.1/iteration — a ~1.46× reduction over the 2-sweep path,
 plus half the kernel launches and half the reduction rounds. fp32
 numerics: the monomial 2-step basis is mildly worse conditioned than
 plain CG; measured in fp32 it reproduces the golden counts exactly at
-every published grid (tests + /tmp-validated 546/989/1858/2449 — see
-BENCH.md for the hardware numbers).
+every published grid (tests + /tmp-validated 546/989/1858/2449).
+Hardware measurement pending: ``benchmarks/tpu_session.py``'s
+``ca_probe`` step captures it on the next healthy tunnel window
+(BENCH.md records CPU/XLA validation only until then).
 
 Single-device, full-width canvases only (the published grids' geometry).
 The sharded variant needs width-2 halos (t2 at a shard edge reaches ±2)

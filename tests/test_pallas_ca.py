@@ -64,8 +64,6 @@ def test_iteration_cap_respected():
 
 
 def test_degenerate_rhs_stops_cleanly():
-    import dataclasses
-
     import jax.numpy as jnp
 
     from poisson_tpu.ops.pallas_ca import _ca_solve, pick_bm_ca
