@@ -14,3 +14,12 @@ import pathlib
 
 RESULTS_DIR = pathlib.Path(__file__).resolve().parent / "results"
 LAYOUT_DECISION_PATH = RESULTS_DIR / "layout_decision.json"
+
+# Hardware-measured single-device backend preference, written by the
+# session after its flagship bench + ca_probe steps: the Pallas backends
+# that actually ran healthy on the chip, fastest first. bench.py uses it
+# as its TPU fallback chain so a driver run never leads with an unproven
+# backend (every demotion costs a compile-and-fail cycle in the driver's
+# budget). Same adoption rules as the layout artifact: BENCH_BACKEND env
+# beats it, unknown names are ignored.
+BACKEND_CHAIN_PATH = RESULTS_DIR / "backend_chain.json"

@@ -131,7 +131,8 @@ class Session:
             return False
 
     def run(self, step: str, argv: list[str], timeout: float,
-            parse_json_tail: bool = False) -> dict | None:
+            parse_json_tail: bool = False,
+            extra_env: dict[str, str] | None = None) -> dict | None:
         """Run a subprocess step; record rc/output; never raise.
 
         Failures return a dict with ``ok: False`` that distinguishes a
@@ -156,8 +157,8 @@ class Session:
             return {"ok": True, "stdout": e.get("stdout", "")}
         try:
             proc = subprocess.run(
-                argv, cwd=_ROOT, env=dict(os.environ), text=True,
-                capture_output=True, timeout=timeout,
+                argv, cwd=_ROOT, env={**os.environ, **(extra_env or {})},
+                text=True, capture_output=True, timeout=timeout,
             )
         except subprocess.TimeoutExpired:
             self.record(step, {"ok": False, "error": f"timeout>{timeout:.0f}s"})
@@ -531,15 +532,92 @@ def main() -> int:
         )
 
     # 2. benches (flagship first: refreshes BENCH_TPU_GOOD.json)
+    bench800 = None
     for grid, to in (((800, 1200), 900), ((1600, 2400), 1200),
                      ((2400, 3200), 1800)):
         if args.quick and grid != (800, 1200):
             continue
-        s.run(f"bench_{grid[0]}x{grid[1]}",
-              [py, "bench.py", str(grid[0]), str(grid[1])],
-              timeout=to, parse_json_tail=True)
+        got = s.run(f"bench_{grid[0]}x{grid[1]}",
+                    [py, "bench.py", str(grid[0]), str(grid[1])],
+                    timeout=to, parse_json_tail=True)
+        if grid == (800, 1200):
+            bench800 = got
 
-    # 3. roofline (full-width strip heights x parallel, plus the
+    # 3. masked sharded kernels on the real chip (1x1 mesh) — a
+    # round-1 ask that repeatedly lost its window to later-step ordering;
+    # cheap, so it runs right after the benches.
+    s.run("sharded_1x1_mosaic", [py, "-c", _SHARDED_1X1],
+          timeout=1200, parse_json_tail=True)
+
+    # 3.5 communication-avoiding pair-iteration: golden + L2 on the
+    # flagship grid, fixed-iteration slope at the 2400x3200 plateau (the
+    # algorithmic traffic-reduction A/B for the roofline story). Ahead
+    # of the rooflines: if the window closes mid-session, the CA
+    # hardware verdict outranks another geometry sweep.
+    ca = s.run("ca_probe", [py, "-c", _CA_PROBE],
+               timeout=1800, parse_json_tail=True)
+
+    # 3.6 hardware-measured backend preference for the driver's bench
+    # chain (see evidence_paths.BACKEND_CHAIN_PATH). Only backends with
+    # affirmative evidence from THIS session enter the chain, each
+    # credited strictly to the backend that actually produced the number
+    # (bench800 may have run either Pallas backend, depending on the
+    # adopted chain). Both sides of the speed comparison come from
+    # bench.py's fetch-cancelled slope methodology — the CA probe's
+    # single-solve timing includes the ~65 ms tunnel fetch constant and
+    # would make CA lose the comparison it deserves to win.
+    def _bench_value(rec, backend_name):
+        if not isinstance(rec, dict):
+            return None
+        det = rec.get("detail") or {}
+        if det.get("backend") == backend_name:
+            return rec.get("value")
+        return None
+
+    fused_v = _bench_value(bench800, "pallas_fused")
+    ca_v = _bench_value(bench800, "pallas_ca")
+    ca_ok = bool(isinstance(ca, dict) and ca.get("ok")
+                 and abs(int(ca.get("flagship_iters") or 0) - 989) <= 9)
+    if ca_ok and ca_v is None:
+        # CA proved correct on hardware but has no bench-grade number
+        # yet: measure it with the same methodology, forced (fails
+        # loudly rather than silently benching another backend).
+        got2 = s.run("bench_800x1200_ca", [py, "bench.py", "800", "1200"],
+                     timeout=900, parse_json_tail=True,
+                     extra_env={"BENCH_BACKEND": "pallas_ca"})
+        ca_v = _bench_value(got2, "pallas_ca")
+    # A Pallas-labeled bench value is affirmative by itself: bench.py's
+    # warm-up enforces the golden count before any backend may produce a
+    # number, so ca_ok only gates the EXTRA forced measurement above.
+    proven = [(name, v) for name, v in
+              (("pallas_ca", ca_v), ("pallas_fused", fused_v)) if v]
+    proven.sort(key=lambda t: -t[1])
+    det800 = (bench800.get("detail") or {}) if isinstance(bench800, dict) \
+        else {}
+    payload = None
+    if proven:
+        payload = {
+            "chain": [n for n, _ in proven], "at": _utc(),
+            "evidence": {n: v for n, v in proven},
+        }
+    elif det800.get("platform") == "tpu" and det800.get("backend") == "xla":
+        # Affirmative negative: the flagship bench ran on real hardware
+        # and every Pallas backend in its chain demoted to xla. Clear
+        # the preference so later driver runs go straight to xla instead
+        # of replaying compile-and-fail cycles from a stale chain.
+        payload = {
+            "chain": [], "at": _utc(),
+            "evidence": {"note": "flagship bench on TPU demoted to xla; "
+                                 "no Pallas backend proved healthy this "
+                                 "session"},
+        }
+    if payload is not None:
+        from benchmarks.evidence_paths import BACKEND_CHAIN_PATH
+        BACKEND_CHAIN_PATH.parent.mkdir(parents=True, exist_ok=True)
+        BACKEND_CHAIN_PATH.write_text(json.dumps(payload, indent=1) + "\n")
+        s.record("backend_chain", payload)
+
+    # 4. roofline (full-width strip heights x parallel, plus the
     # column-blocked geometry at its auto strip height)
     s.run("roofline_2400x3200", [
         py, "benchmarks/roofline.py", "2400", "3200",
@@ -554,16 +632,6 @@ def main() -> int:
             py, "benchmarks/roofline.py", "1600", "2400",
             "--bm", "64,128", "--iters", "200", "--parallel",
         ], timeout=1200, parse_json_tail=True)
-
-    # 3.5 communication-avoiding pair-iteration: golden + L2 on the
-    # flagship grid, fixed-iteration slope at the 2400x3200 plateau (the
-    # algorithmic traffic-reduction A/B for the roofline story).
-    s.run("ca_probe", [py, "-c", _CA_PROBE],
-          timeout=1800, parse_json_tail=True)
-
-    # 4. masked sharded kernels on the real chip (1x1 mesh)
-    s.run("sharded_1x1_mosaic", [py, "-c", _SHARDED_1X1],
-          timeout=1200, parse_json_tail=True)
 
     # 5. beyond-reference grids (full-width and column-blocked geometries)
     s.run("grid_4800x4800", [py, "-c", _BIG_GRID, "4800", "4800", "50"],
