@@ -149,15 +149,22 @@ def _measured_chain() -> list[str] | None:
         data = json.loads(BACKEND_CHAIN_PATH.read_text())
     except (OSError, ValueError):
         return None
-    if "chain" not in data or not isinstance(data["chain"], list):
-        return None
+    if not isinstance(data, dict) or not isinstance(data.get("chain"), list):
+        return None  # truncated/corrupt artifact: fall back to the default
     chain = [name for name in data["chain"] if name in _KNOWN_SINGLE_DEVICE]
     if chain:
         print(f"bench: adopting measured backend chain {chain} "
               f"(session {data.get('at')})", file=sys.stderr)
-    else:
-        print("bench: session recorded no healthy Pallas backend "
-              f"({data.get('at')}); going straight to xla", file=sys.stderr)
+        return chain
+    if data["chain"]:
+        # Every recorded name is unknown to this build (newer session, or
+        # a hand-edited file): that is positive evidence we cannot use,
+        # NOT negative evidence — use the static default chain.
+        print(f"bench: measured chain {data['chain']} has no backend "
+              "this build knows; using the default chain", file=sys.stderr)
+        return None
+    print("bench: session recorded no healthy Pallas backend "
+          f"({data.get('at')}); going straight to xla", file=sys.stderr)
     return chain
 
 

@@ -1,0 +1,247 @@
+"""Unit tests for benchmarks/tpu_session.py's decision logic.
+
+The session itself needs the real chip, but its three decision mechanisms
+are pure logic that has already eaten review findings twice — these tests
+pin them:
+
+- ``decide_backend_chain``: which Pallas backends are credited as
+  hardware-proven, in what order, when the forced re-measurements fire,
+  and when the affirmative-negative empty chain is written.
+- ``Session`` resume filtering: which prior log entries may satisfy a
+  re-armed session.
+- ``Session.run`` skip/replay behavior around the wedge-defense abort.
+- ``bench._measured_chain``: artifact adoption, including corrupt and
+  unknown-name artifacts.
+
+No test here touches a JAX backend (no device, no tunnel).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+
+import pytest
+
+_ROOT = __file__.rsplit("/tests/", 1)[0]
+_spec = importlib.util.spec_from_file_location(
+    "tpu_session", _ROOT + "/benchmarks/tpu_session.py"
+)
+tpu_session = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(tpu_session)
+
+
+def _bench(backend, value, platform="tpu"):
+    return {"value": value,
+            "detail": {"backend": backend, "platform": platform}}
+
+
+def _no_runner():
+    raise AssertionError("forced bench runner must not be called")
+
+
+def _decide(bench800, ca, fused_probe_ok=False,
+            ca_runner=_no_runner, fused_runner=_no_runner):
+    return tpu_session.decide_backend_chain(
+        bench800, ca, fused_probe_ok, ca_runner, fused_runner
+    )
+
+
+class TestDecideBackendChain:
+    def test_fused_only(self):
+        got = _decide(_bench("pallas_fused", 40000.0), {"ok": False})
+        assert got["chain"] == ["pallas_fused"]
+        assert got["evidence"] == {"pallas_fused": 40000.0}
+
+    def test_ca_promoted_when_faster(self):
+        ca = {"ok": True, "flagship_iters": 989}
+        got = _decide(_bench("pallas_fused", 40000.0), ca,
+                      ca_runner=lambda: _bench("pallas_ca", 55000.0))
+        assert got["chain"] == ["pallas_ca", "pallas_fused"]
+        assert got["evidence"] == {"pallas_ca": 55000.0,
+                                   "pallas_fused": 40000.0}
+
+    def test_ca_behind_when_slower(self):
+        ca = {"ok": True, "flagship_iters": 989}
+        got = _decide(_bench("pallas_fused", 40000.0), ca,
+                      ca_runner=lambda: _bench("pallas_ca", 30000.0))
+        assert got["chain"] == ["pallas_fused", "pallas_ca"]
+
+    def test_bench_on_ca_does_not_credit_fused(self):
+        # bench800 ran pallas_ca (a prior chain led with it); the CA probe
+        # then timed out and the kernel probe was inconclusive. fused has
+        # NO evidence this session and must not enter the chain.
+        got = _decide(_bench("pallas_ca", 50000.0), {"timeout": True})
+        assert got["chain"] == ["pallas_ca"]
+        assert got["evidence"] == {"pallas_ca": 50000.0}
+
+    def test_fused_probe_triggers_forced_measurement(self):
+        # The ratchet-breaker: bench800 ran pallas_ca, but the kernel
+        # probe proved the fused path healthy — fused gets a bench-grade
+        # forced measurement and re-enters the chain.
+        got = _decide(_bench("pallas_ca", 50000.0), {"timeout": True},
+                      fused_probe_ok=True,
+                      fused_runner=lambda: _bench("pallas_fused", 42000.0))
+        assert got["chain"] == ["pallas_ca", "pallas_fused"]
+
+    def test_forced_fused_demotion_is_not_credited(self):
+        got = _decide(_bench("pallas_ca", 50000.0), {"timeout": True},
+                      fused_probe_ok=True,
+                      fused_runner=lambda: {"ok": False, "rc": 1})
+        assert got["chain"] == ["pallas_ca"]
+
+    def test_forced_ca_bench_demotion_is_not_credited(self):
+        ca = {"ok": True, "flagship_iters": 989}
+        got = _decide(_bench("pallas_fused", 40000.0), ca,
+                      ca_runner=lambda: {"ok": False, "rc": 1})
+        assert got["chain"] == ["pallas_fused"]
+
+    def test_all_demoted_on_tpu_writes_empty_chain(self):
+        got = _decide(_bench("xla", 23000.0), {"ok": False, "error": "x"})
+        assert got["chain"] == []
+
+    def test_probe_rescues_even_after_bench_demotion(self):
+        # bench800 demoted to xla, but the kernel probe passed (e.g. the
+        # gate switched layouts after bench800's chain had already
+        # demoted): the forced measurement still gives fused its chance
+        # before any negative verdict.
+        got = _decide(_bench("xla", 23000.0), {"ok": False},
+                      fused_probe_ok=True,
+                      fused_runner=lambda: _bench("pallas_fused", 41000.0))
+        assert got["chain"] == ["pallas_fused"]
+
+    def test_cpu_fallback_makes_no_statement(self):
+        got = _decide(_bench("xla", 160.0, platform="cpu"), None)
+        assert got is None
+
+    def test_bench_timeout_makes_no_statement(self):
+        got = _decide({"ok": False, "timeout": True}, None)
+        assert got is None
+
+    def test_ca_suspect_iterations_not_probed_further(self):
+        ca = {"ok": True, "flagship_iters": 1200}
+        got = _decide(_bench("pallas_fused", 40000.0), ca)
+        assert got["chain"] == ["pallas_fused"]
+
+
+class TestMeasuredChainAdoption:
+    @pytest.fixture()
+    def bench_mod(self, tmp_path, monkeypatch):
+        sys.path.insert(0, _ROOT)
+        import bench
+        monkeypatch.setattr(
+            bench, "BACKEND_CHAIN_PATH", tmp_path / "backend_chain.json"
+        )
+        return bench
+
+    def _write(self, bench_mod, content: str):
+        bench_mod.BACKEND_CHAIN_PATH.write_text(content)
+
+    def test_missing_artifact(self, bench_mod):
+        assert bench_mod._measured_chain() is None
+
+    def test_adopts_known_names_in_order(self, bench_mod):
+        self._write(bench_mod, json.dumps(
+            {"chain": ["pallas_ca", "bogus", "pallas_fused"], "at": "T"}
+        ))
+        assert bench_mod._measured_chain() == ["pallas_ca", "pallas_fused"]
+
+    def test_explicit_empty_chain_is_negative_evidence(self, bench_mod):
+        self._write(bench_mod, json.dumps({"chain": [], "at": "T"}))
+        assert bench_mod._measured_chain() == []
+
+    def test_unknown_names_only_falls_back_to_default(self, bench_mod):
+        # Positive evidence this build cannot use is NOT negative
+        # evidence: fall back to the static chain.
+        self._write(bench_mod, json.dumps({"chain": ["pallas_v2"]}))
+        assert bench_mod._measured_chain() is None
+
+    @pytest.mark.parametrize("content", ["null", "3", '"x"', "{", "",
+                                         '{"chain": 7}'])
+    def test_corrupt_artifact_falls_back(self, bench_mod, content):
+        self._write(bench_mod, content)
+        assert bench_mod._measured_chain() is None
+
+
+class TestSessionResume:
+    def _mklog(self, tmp_path, entries):
+        log = tmp_path / "session.jsonl"
+        log.write_text("".join(json.dumps(e) + "\n" for e in entries))
+        return tmp_path
+
+    def test_prior_filtering(self, tmp_path):
+        outdir = self._mklog(tmp_path, [
+            {"step": "old", "at": "2026-07-29T00:00:00+00:00", "ok": True,
+             "result": {"v": 1}},
+            {"step": "fresh", "at": "2026-07-30T06:00:00+00:00", "ok": True,
+             "result": {"v": 2}},
+            {"step": "failed", "at": "2026-07-30T06:01:00+00:00",
+             "ok": False, "rc": 1},
+            {"step": "identity", "at": "2026-07-30T06:02:00+00:00",
+             "ok": True, "result": {"platform": "tpu"}},
+            {"step": "nullres", "at": "2026-07-30T06:03:00+00:00",
+             "ok": True, "result": None},
+        ])
+        s = tpu_session.Session(
+            outdir, resume_after="2026-07-30T00:00:00+00:00"
+        )
+        # old (stale), failed, identity (always live), and null results
+        # are all excluded; only the fresh ok step replays.
+        assert set(s.prior) == {"fresh"}
+
+    def test_no_resume_means_no_prior(self, tmp_path):
+        outdir = self._mklog(tmp_path, [
+            {"step": "fresh", "at": "2026-07-30T06:00:00+00:00", "ok": True,
+             "result": {"v": 2}},
+        ])
+        assert tpu_session.Session(outdir).prior == {}
+
+    def test_replay_returns_prior_result(self, tmp_path):
+        outdir = self._mklog(tmp_path, [
+            {"step": "fresh", "at": "2026-07-30T06:00:00+00:00", "ok": True,
+             "result": {"v": 2}},
+        ])
+        s = tpu_session.Session(
+            outdir, resume_after="2026-07-30T00:00:00+00:00"
+        )
+        got = s.run("fresh", ["false"], timeout=5, parse_json_tail=True)
+        assert got == {"v": 2}  # the subprocess ("false") never ran
+
+    def test_abort_skips_subsequent_steps(self, tmp_path):
+        s = tpu_session.Session(tmp_path)
+        s.aborted = True
+        got = s.run("anything", ["true"], timeout=5, parse_json_tail=True)
+        assert got.get("skipped") and not got.get("timeout")
+
+    def test_step_success_and_failure_recording(self, tmp_path):
+        s = tpu_session.Session(tmp_path)
+        ok = s.run("good", [sys.executable, "-c", "print('{\"x\": 1}')"],
+                   timeout=30, parse_json_tail=True)
+        assert ok == {"x": 1}
+        bad = s.run("bad", [sys.executable, "-c",
+                            "import sys; print('boom', file=sys.stderr); "
+                            "sys.exit(3)"], timeout=30)
+        assert bad == {"ok": False, "rc": 3}
+        # full stderr rides along as a file for root-causing
+        assert (tmp_path / "bad_stderr.txt").read_text().strip() == "boom"
+
+    def test_extra_env_reaches_the_step(self, tmp_path):
+        s = tpu_session.Session(tmp_path)
+        got = s.run("env", [sys.executable, "-c",
+                            "import os, json; "
+                            "print(json.dumps({'b': os.environ.get('BENCH_BACKEND')}))"],
+                    timeout=30, parse_json_tail=True,
+                    extra_env={"BENCH_BACKEND": "pallas_ca"})
+        assert got == {"b": "pallas_ca"}
+
+    def test_decide_layout_artifact_semantics(self, tmp_path, monkeypatch):
+        import benchmarks.evidence_paths as ep
+
+        target = tmp_path / "layout_decision.json"
+        monkeypatch.setattr(ep, "LAYOUT_DECISION_PATH", target)
+        s = tpu_session.Session(tmp_path)
+        s.decide_layout(False, "inconclusive", affirmative=False)
+        assert not target.exists()  # no artifact without evidence
+        s.decide_layout(True, "serial proved healthy")
+        assert json.loads(target.read_text())["serial_reduce"] is True
