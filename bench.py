@@ -163,8 +163,10 @@ def _measured_chain() -> list[str] | None:
         print(f"bench: measured chain {data['chain']} has no backend "
               "this build knows; using the default chain", file=sys.stderr)
         return None
-    print("bench: session recorded no healthy Pallas backend "
-          f"({data.get('at')}); going straight to xla", file=sys.stderr)
+    note = data.get("note") or ("session recorded no healthy Pallas "
+                                "backend")
+    print(f"bench: {note} ({data.get('at')}); going straight to xla",
+          file=sys.stderr)
     return chain
 
 

@@ -408,18 +408,23 @@ print(json.dumps(out))
 
 def _bench_value(rec, backend_name: str):
     """The bench.py headline value from ``rec``, credited ONLY when the
-    record says that exact backend produced it (bench800 may have run
-    either Pallas backend, depending on the chain it adopted)."""
+    record says that exact backend produced it ON REAL HARDWARE.
+    bench800 may have run either Pallas backend (depending on the chain
+    it adopted), and any bench run can CPU-downgrade mid-session when
+    the tunnel wedges — a ~160 MLUPS CPU number must never enter the
+    artifact as hardware evidence (the forced-xla run reports
+    backend="xla" on the CPU fallback too)."""
     if not isinstance(rec, dict):
         return None
     det = rec.get("detail") or {}
-    if det.get("backend") == backend_name:
+    if det.get("backend") == backend_name and det.get("platform") == "tpu":
         return rec.get("value")
     return None
 
 
 def decide_backend_chain(bench800, ca, fused_probe_ok,
-                         bench_ca_runner, bench_fused_runner):
+                         bench_ca_runner, bench_fused_runner,
+                         xla_runner=None):
     """The backend-preference artifact payload, or None for no statement.
 
     Only backends with affirmative evidence from THIS session enter the
@@ -455,17 +460,39 @@ def decide_backend_chain(bench800, ca, fused_probe_ok,
     proven.sort(key=lambda t: -t[1])
     det800 = (bench800.get("detail") or {}) if isinstance(bench800, dict) \
         else {}
-    if proven:
+    xla_v = _bench_value(bench800, "xla")
+    if xla_v is None and xla_runner is not None and proven:
+        # The Pallas pass models are unvalidated against this chip (the
+        # prior round's Pallas rows imply >2 TB/s on an ~0.8 TB/s part,
+        # i.e. a measurement artifact) — XLA's fusion may honestly win.
+        # The chain must reflect the measured maximum, so XLA gets the
+        # same bench-grade measurement as the Pallas candidates.
+        xla_v = _bench_value(xla_runner(), "xla")
+    evidence = dict(proven)
+    if xla_v is not None:
+        evidence["xla"] = xla_v
+    if proven and (xla_v is None or proven[0][1] > xla_v):
         return {
             "chain": [n for n, _ in proven], "at": _utc(),
-            "evidence": {n: v for n, v in proven},
+            "evidence": evidence,
+        }
+    if proven:
+        # Pallas backends ran healthy but XLA measured faster: the
+        # driver's headline should be the measured maximum, so the chain
+        # is empty (bench goes straight to xla) with the losing Pallas
+        # numbers preserved as evidence.
+        return {
+            "chain": [], "at": _utc(),
+            "evidence": evidence,
+            "note": "xla measured fastest on hardware this session; "
+                    "healthy Pallas numbers preserved in evidence",
         }
     if det800.get("platform") == "tpu" and det800.get("backend") == "xla":
         return {
             "chain": [], "at": _utc(),
-            "evidence": {"note": "flagship bench on TPU demoted to xla; "
-                                 "no Pallas backend proved healthy this "
-                                 "session"},
+            "evidence": evidence,
+            "note": "flagship bench on TPU demoted to xla; no Pallas "
+                    "backend proved healthy this session",
         }
     return None
 
@@ -643,6 +670,10 @@ def main() -> int:
                       [py, "bench.py", "800", "1200"],
                       timeout=900, parse_json_tail=True,
                       extra_env={"BENCH_BACKEND": "pallas_fused"}),
+        xla_runner=lambda: s.run(
+            "bench_800x1200_xla", [py, "bench.py", "800", "1200"],
+            timeout=900, parse_json_tail=True,
+            extra_env={"BENCH_BACKEND": "xla"}),
     )
     if payload is not None:
         from benchmarks.evidence_paths import BACKEND_CHAIN_PATH

@@ -42,9 +42,11 @@ def _no_runner():
 
 
 def _decide(bench800, ca, fused_probe_ok=False,
-            ca_runner=_no_runner, fused_runner=_no_runner):
+            ca_runner=_no_runner, fused_runner=_no_runner,
+            xla_runner=None):
     return tpu_session.decide_backend_chain(
-        bench800, ca, fused_probe_ok, ca_runner, fused_runner
+        bench800, ca, fused_probe_ok, ca_runner, fused_runner,
+        xla_runner=xla_runner,
     )
 
 
@@ -110,6 +112,45 @@ class TestDecideBackendChain:
                       fused_probe_ok=True,
                       fused_runner=lambda: _bench("pallas_fused", 41000.0))
         assert got["chain"] == ["pallas_fused"]
+
+    def test_xla_winning_empties_the_chain_with_evidence(self):
+        got = _decide(_bench("pallas_fused", 20000.0), {"ok": False},
+                      xla_runner=lambda: _bench("xla", 24000.0))
+        assert got["chain"] == []
+        assert got["evidence"] == {"pallas_fused": 20000.0, "xla": 24000.0}
+        assert "xla measured fastest" in got["note"]
+
+    def test_xla_losing_keeps_the_chain_and_the_comparison(self):
+        got = _decide(_bench("pallas_fused", 40000.0), {"ok": False},
+                      xla_runner=lambda: _bench("xla", 24000.0))
+        assert got["chain"] == ["pallas_fused"]
+        assert got["evidence"] == {"pallas_fused": 40000.0, "xla": 24000.0}
+
+    def test_failed_xla_measurement_keeps_proven_chain(self):
+        got = _decide(_bench("pallas_fused", 20000.0), {"ok": False},
+                      xla_runner=lambda: {"ok": False, "timeout": True})
+        assert got["chain"] == ["pallas_fused"]
+
+    def test_cpu_downgraded_xla_run_is_not_hardware_evidence(self):
+        # The forced xla bench wedged mid-session and CPU-downgraded:
+        # its ~160 MLUPS number must not enter the artifact, and the
+        # proven Pallas chain must not be compared against it.
+        got = _decide(_bench("pallas_fused", 20000.0), {"ok": False},
+                      xla_runner=lambda: _bench("xla", 160.0,
+                                                platform="cpu"))
+        assert got["chain"] == ["pallas_fused"]
+        assert "xla" not in got["evidence"]
+
+    def test_bench800_xla_value_reused_without_runner(self):
+        # bench800 itself ran xla (demoted chain); a probe-rescued fused
+        # measurement still gets compared against that xla number with no
+        # second forced xla run.
+        got = _decide(_bench("xla", 24000.0), {"ok": False},
+                      fused_probe_ok=True,
+                      fused_runner=lambda: _bench("pallas_fused", 20000.0),
+                      xla_runner=_no_runner)
+        assert got["chain"] == []
+        assert got["evidence"] == {"pallas_fused": 20000.0, "xla": 24000.0}
 
     def test_cpu_fallback_makes_no_statement(self):
         got = _decide(_bench("xla", 160.0, platform="cpu"), None)
