@@ -12,6 +12,13 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
+# Honor JAX_PLATFORMS before any device touch: site hooks registering a
+# remote-accelerator plugin override jax.config at interpreter startup
+# (config beats env), and a wedged tunnel then hangs the first jax call.
+from poisson_tpu.utils.platform import honor_jax_platforms_env
+
+honor_jax_platforms_env()
+
 import jax
 
 jax.config.update("jax_enable_x64", True)  # delta=1e-10 needs fp64 state
