@@ -65,10 +65,10 @@ def _parse_args(argv):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--grids", default="40x40,400x600,800x1200")
     p.add_argument("--backends", default="auto",
-                   help="comma list of xla,pallas,sharded,pallas-sharded,"
-                        "native; 'auto' = xla+native, plus sharded when >1 "
-                        "device, plus pallas (and pallas-sharded when >1 "
-                        "device) on TPU")
+                   help="comma list of xla,pallas,pallas-ca,sharded,"
+                        "pallas-sharded,native; 'auto' = xla+native, plus "
+                        "sharded when >1 device, plus pallas (and "
+                        "pallas-sharded when >1 device) on TPU")
     p.add_argument("--meshes", default=None,
                    help="comma list like 1x1,2x2,2x4 (sharded rows; default: "
                         "near-square over all devices)")
@@ -171,6 +171,13 @@ def main(argv=None) -> int:
                 res, best = _timed(lambda: pallas_cg_solve(problem), fence,
                                    args.repeat)
                 rows.append(_row("pallas", "1 dev fused", problem,
+                                 int(res.iterations), best, l2(problem, res.w)))
+            elif backend == "pallas-ca":
+                from poisson_tpu.ops.pallas_ca import ca_cg_solve
+
+                res, best = _timed(lambda: ca_cg_solve(problem), fence,
+                                   args.repeat)
+                rows.append(_row("pallas-ca", "1 dev s=2 pairs", problem,
                                  int(res.iterations), best, l2(problem, res.w)))
             elif backend in ("sharded", "pallas-sharded"):
                 from poisson_tpu.parallel import (

@@ -730,7 +730,7 @@ def main() -> int:
         s.run("sweep_table", [
             py, "benchmarks/sweep.py", "--grids",
             "400x600,800x1200,1600x2400,2400x3200",
-            "--backends", "pallas,xla", "--repeat", "2",
+            "--backends", "pallas,pallas-ca,xla", "--repeat", "2",
             "--out", str(s.outdir / "sweep_tpu.md"),
         ], timeout=3600)
 
