@@ -11,14 +11,25 @@
 # the wedge clears (remove $RESULTS/session_launched to re-arm manually).
 # After ONE clean session the watch exits — evidence captured, stop
 # touching the tunnel.
-cd /root/repo || exit 1
-RESULTS=benchmarks/results
+#
+# The TUNNEL_WATCH_* envs exist for the test harness
+# (tests/test_tunnel_watch.py): they swap the repo/results dirs, the
+# python binary, and the wait intervals so the loop's re-arm/pidfile/exit
+# logic can be exercised in seconds with a stubbed interpreter. Production
+# use needs none of them.
+REPO=${TUNNEL_WATCH_REPO:-/root/repo}
+cd "$REPO" || exit 1
+RESULTS=${TUNNEL_WATCH_RESULTS:-benchmarks/results}
+PY=${TUNNEL_WATCH_PYTHON:-python}
+POLL=${TUNNEL_WATCH_POLL:-120}
+COOLDOWN=${TUNNEL_WATCH_COOLDOWN:-600}
+PROBE_TIMEOUT=${TUNNEL_WATCH_PROBE_TIMEOUT:-90}
 mkdir -p "$RESULTS"
 PIDFILE=$RESULTS/tunnel_watch.pid
 if [ -f "$PIDFILE" ]; then
   owner=$(cat "$PIDFILE" 2>/dev/null)
   if [ -n "$owner" ] && kill -0 "$owner" 2>/dev/null; then
-    echo "$(date -u +%FT%TZ) watch already running (pid $owner); exiting" \
+    echo "$(date -u +%FT%TZ) another watch (pid $owner) is alive; exiting" \
       >> "$RESULTS/tunnel_probe.log"
     exit 0
   fi
@@ -33,7 +44,7 @@ RESUME_ARGS=""
 echo "$(date -u +%FT%TZ) watch started (pid $$)" >> "$RESULTS/tunnel_probe.log"
 while true; do
   TS=$(date -u +%FT%TZ)
-  if timeout 90 python -c "
+  if timeout "$PROBE_TIMEOUT" "$PY" -c "
 from poisson_tpu.utils.platform import honor_jax_platforms_env
 honor_jax_platforms_env()
 import jax
@@ -44,7 +55,7 @@ assert jax.devices()[0].platform == 'tpu'
       touch "$RESULTS/session_launched"
       echo "$TS launching tpu_session.py $RESUME_ARGS" >> "$RESULTS/tunnel_probe.log"
       # shellcheck disable=SC2086
-      python benchmarks/tpu_session.py $RESUME_ARGS >> "$RESULTS/tpu_session_stdout.log" 2>&1
+      "$PY" benchmarks/tpu_session.py $RESUME_ARGS >> "$RESULTS/tpu_session_stdout.log" 2>&1
       rc=$?
       echo "$(date -u +%FT%TZ) session exited rc=$rc" >> "$RESULTS/tunnel_probe.log"
       if [ "$rc" = "0" ]; then
@@ -58,10 +69,10 @@ assert jax.devices()[0].platform == 'tpu'
       # generation already completed instead of re-running them.
       rm -f "$RESULTS/session_launched"
       RESUME_ARGS="--resume-after $WATCH_START"
-      sleep 600
+      sleep "$COOLDOWN"
     fi
   else
     echo "$TS wedged" >> "$RESULTS/tunnel_probe.log"
   fi
-  sleep 120
+  sleep "$POLL"
 done

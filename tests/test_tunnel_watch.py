@@ -1,0 +1,175 @@
+"""Behavioral tests for benchmarks/tunnel_watch.sh.
+
+The watch loop is the mechanism that converts a transient healthy-tunnel
+window into committed hardware evidence — a bug in its re-arm/pidfile/
+exit logic silently costs the round its only measurement opportunity
+(the round-3 postmortem). These tests run the real script with a stubbed
+``python`` whose behavior is scripted per-call through control files, so
+every decision path executes in seconds with zero TPU contact.
+
+Stub protocol (see ``_stub``): the fake interpreter distinguishes a
+probe (``-c`` with the jax snippet) from a session launch
+(``benchmarks/tpu_session.py ...``), consumes one line of its control
+file per call (``healthy``/``wedged`` for probes, an integer exit code
+for sessions), and appends what it saw — including any --resume-after
+argv — to a call log the assertions read.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import time
+
+import pytest
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+_SCRIPT = _ROOT / "benchmarks" / "tunnel_watch.sh"
+
+_STUB = r"""#!/bin/bash
+# Fake python for tunnel_watch tests. $CTRL_DIR is baked in at write time.
+CTRL={ctrl}
+LOG=$CTRL/calls.log
+if [ "$1" = "-c" ]; then
+    echo "probe" >> "$LOG"
+    verdict=$(head -n1 "$CTRL/probes")
+    sed -i 1d "$CTRL/probes"
+    [ "$verdict" = "healthy" ] && exit 0
+    exit 1
+fi
+echo "session $*" >> "$LOG"
+rc=$(head -n1 "$CTRL/sessions")
+sed -i 1d "$CTRL/sessions"
+exit "$rc"
+"""
+
+
+class Harness:
+    def __init__(self, tmp_path: pathlib.Path):
+        self.ctrl = tmp_path / "ctrl"
+        self.results = tmp_path / "results"
+        self.repo = tmp_path / "repo"
+        for d in (self.ctrl, self.results, self.repo):
+            d.mkdir()
+        stub = tmp_path / "fakepython"
+        stub.write_text(_STUB.format(ctrl=self.ctrl))
+        stub.chmod(0o755)
+        self.stub = stub
+        (self.ctrl / "calls.log").write_text("")
+        self.env = {
+            **os.environ,
+            "TUNNEL_WATCH_REPO": str(self.repo),
+            "TUNNEL_WATCH_RESULTS": str(self.results),
+            "TUNNEL_WATCH_PYTHON": str(stub),
+            "TUNNEL_WATCH_POLL": "0",
+            "TUNNEL_WATCH_COOLDOWN": "0",
+            "TUNNEL_WATCH_PROBE_TIMEOUT": "5",
+        }
+
+    def script(self, probes: list[str], sessions: list[int]):
+        (self.ctrl / "probes").write_text(
+            "".join(p + "\n" for p in probes)
+        )
+        (self.ctrl / "sessions").write_text(
+            "".join(f"{rc}\n" for rc in sessions)
+        )
+
+    def run(self, timeout=20) -> subprocess.CompletedProcess:
+        return subprocess.run(
+            ["bash", str(_SCRIPT)], env=self.env, text=True,
+            capture_output=True, timeout=timeout,
+        )
+
+    def calls(self) -> list[str]:
+        return (self.ctrl / "calls.log").read_text().splitlines()
+
+    def log(self) -> str:
+        return (self.results / "tunnel_probe.log").read_text()
+
+
+@pytest.fixture()
+def harness(tmp_path):
+    return Harness(tmp_path)
+
+
+def test_clean_session_exits_watch(harness):
+    harness.script(probes=["wedged", "healthy"], sessions=[0])
+    proc = harness.run()
+    assert proc.returncode == 0
+    calls = harness.calls()
+    # one failed probe, one healthy probe, one session, then exit —
+    # crucially NO further probes after the clean session (the watch must
+    # stop being a tunnel client).
+    assert calls == ["probe", "probe", "session benchmarks/tpu_session.py"]
+    assert "watch done (clean session)" in harness.log()
+    # pidfile cleaned up on exit
+    assert not (harness.results / "tunnel_watch.pid").exists()
+
+
+def test_failed_session_rearms_with_resume(harness):
+    harness.script(probes=["healthy", "healthy"], sessions=[2, 0])
+    proc = harness.run()
+    assert proc.returncode == 0
+    calls = harness.calls()
+    assert calls[0] == "probe"
+    assert calls[1] == "session benchmarks/tpu_session.py"
+    # the re-armed launch passes --resume-after <watch start>
+    assert calls[2] == "probe"
+    assert calls[3].startswith(
+        "session benchmarks/tpu_session.py --resume-after "
+    )
+    assert "watch done (clean session)" in harness.log()
+
+
+def test_identity_gate_failure_rearms_too(harness):
+    # rc=1 (tunnel died between probe and identity step) re-arms exactly
+    # like the wedge-defense rc=2.
+    harness.script(probes=["healthy", "healthy"], sessions=[1, 0])
+    proc = harness.run()
+    assert proc.returncode == 0
+    assert [c.split()[0] for c in harness.calls()] == [
+        "probe", "session", "probe", "session"
+    ]
+
+
+def test_wedged_probes_never_launch(harness):
+    # All probes wedged: loop keeps probing; kill it after a few polls
+    # and verify no session was ever attempted. A small nonzero poll
+    # keeps the loop from busy-forking, and the timeout is generous so a
+    # loaded machine still completes several probes first.
+    harness.env["TUNNEL_WATCH_POLL"] = "0.1"
+    harness.script(probes=["wedged"] * 500, sessions=[])
+    with pytest.raises(subprocess.TimeoutExpired):
+        harness.run(timeout=8)
+    calls = harness.calls()
+    assert calls and all(c == "probe" for c in calls)
+    assert "wedged" in harness.log()
+
+
+def test_second_instance_bows_out(harness):
+    # A live pid in the pidfile (this test process) must make a new watch
+    # exit immediately without probing.
+    (harness.results / "tunnel_watch.pid").write_text(str(os.getpid()))
+    harness.script(probes=["healthy"], sessions=[0])
+    proc = harness.run()
+    assert proc.returncode == 0
+    assert harness.calls() == []
+    assert "is alive; exiting" in harness.log()
+    # the live owner's pidfile is left untouched
+    assert (harness.results / "tunnel_watch.pid").read_text() == str(
+        os.getpid()
+    )
+
+
+def test_stale_pidfile_is_reclaimed(harness):
+    # A dead owner's pidfile must not block a new watch.
+    dead = subprocess.Popen(["true"])
+    dead.wait()
+    (harness.results / "tunnel_watch.pid").write_text(str(dead.pid))
+    # give the pid a moment to be certainly unkillable-0
+    time.sleep(0.1)
+    harness.script(probes=["healthy"], sessions=[0])
+    proc = harness.run()
+    assert proc.returncode == 0
+    assert "watch done (clean session)" in harness.log()
