@@ -1,0 +1,107 @@
+"""Summarize a TPU evidence session log as a markdown table.
+
+Reads ``benchmarks/results/session.jsonl`` (or the path given) and
+prints one row per step with the numbers that matter for BENCH.md —
+backend, MLUPS, iterations vs golden, L2 — plus the layout and
+backend-chain decisions. The table is the working draft for the
+post-session BENCH.md update; the jsonl stays the ground truth.
+
+Usage: python benchmarks/summarize_session.py [session.jsonl] [--since ISO]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _first(*vals):
+    """First value that is present — unlike an ``or`` chain, a legitimate
+    0/0.0 is a value, not a missing field."""
+    for v in vals:
+        if v is not None:
+            return v
+    return None
+
+
+def _row_from(step: str, e: dict) -> list[str] | None:
+    at = e.get("at", "—")
+    r = e.get("result")
+    if not isinstance(r, dict):
+        if "ok" in e:
+            status = "ok" if e["ok"] else (
+                f"rc={e['rc']}" if "rc" in e else
+                str(e.get("error", e.get("skipped", "failed")))
+            )
+        else:
+            # Bookkeeping entries (done/abort) carry neither ok nor a
+            # result; show their payload rather than implying failure.
+            status = json.dumps(
+                {k: v for k, v in e.items() if k not in ("step", "at")}
+            )
+        return [step, status[:60], "—", "—", "—", at]
+    det = r.get("detail") or {}
+    backend = _first(det.get("backend"), r.get("backend"), "—")
+    platform = _first(det.get("platform"), r.get("platform"),
+                      "tpu" if ("device_kind" in r or "kind" in r) else "—")
+    mlups = _first(r.get("value"), r.get("mlups"), r.get("flagship_mlups"),
+                   r.get("big_mlups"))
+    iters = _first(det.get("iterations"), r.get("iterations"),
+                   r.get("flagship_iters"))
+    l2 = _first(det.get("l2_error_vs_analytic"), r.get("l2"),
+                r.get("l2_error"))
+    status = "ok" if r.get("ok", e.get("ok")) else "FAILED"
+    return [step, f"{backend} ({platform}) {status}", _fmt(mlups),
+            _fmt(iters), _fmt(l2), at]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log", nargs="?", default=str(
+        _ROOT / "benchmarks" / "results" / "session.jsonl"))
+    ap.add_argument("--since", default=None, metavar="ISO_UTC",
+                    help="only entries at/after this UTC timestamp")
+    args = ap.parse_args()
+    path = pathlib.Path(args.log)
+    if not path.exists():
+        print(f"no session log at {path}", file=sys.stderr)
+        return 1
+    rows, decisions = [], []
+    for line in path.read_text().splitlines():
+        try:
+            e = json.loads(line)
+        except ValueError:
+            continue
+        if args.since and e.get("at", "") < args.since:
+            continue
+        step = e.get("step", "?")
+        if step in ("layout_decision", "backend_chain"):
+            decisions.append((e.get("at"), step, e))
+            continue
+        row = _row_from(step, e)
+        if row:
+            rows.append(row)
+    print("| step | backend/status | MLUPS | iters | L2 | at |")
+    print("|---|---|---|---|---|---|")
+    for row in rows:
+        print("| " + " | ".join(row) + " |")
+    for at, step, e in decisions:
+        body = {k: v for k, v in e.items() if k not in ("step", "at")}
+        print(f"\n**{step}** ({at}): {json.dumps(body)[:400]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
