@@ -46,6 +46,52 @@ def _utc() -> str:
     )
 
 
+def _recorded_layouts(rec) -> set:
+    """Every reduction layout a step's recorded result attributes itself
+    to, wherever the step reports it: top level (the kernel/CA/grid
+    probes), ``detail`` (bench.py's JSON line), or per-row ``solver``
+    entries (roofline.py's report). A bench record whose backend is
+    ``xla`` makes no layout claim — the stamp records the ambient env,
+    but no Pallas kernel ran, so the number is layout-independent. A
+    result naming NO layout — or, pathologically, two — is handled by
+    the caller (no-claim replays stand; mixed-layout results can never
+    match one launch layout and are dropped)."""
+    found = set()
+    if not isinstance(rec, dict):
+        return found
+    if rec.get("serial_reduce") is not None:
+        found.add(bool(rec["serial_reduce"]))
+    det = rec.get("detail")
+    if isinstance(det, dict) and det.get("serial_reduce") is not None \
+            and det.get("backend") != "xla":
+        found.add(bool(det["serial_reduce"]))
+    rows = rec.get("solver")
+    if isinstance(rows, list):
+        for row in rows:
+            if isinstance(row, dict) and row.get("serial_reduce") is not None:
+                found.add(bool(row["serial_reduce"]))
+    return found
+
+
+def _predicted_bench_layout(pinned: bool, env_pinned: bool) -> bool:
+    """The layout a bench.py step launched now would actually run:
+    the env pin when one is set, else the adopted layout_decision
+    artifact (bench.py._adopt_layout_decision), else the per-strip
+    default. The distinction matters on re-armed launches: a session
+    that A/B-flipped to serial-Kahan wrote an affirmative artifact, so
+    its bench replays are still exactly what a live re-run would
+    measure even though the relaunch env carries no pin — dropping them
+    would burn the fragile window re-measuring identical numbers."""
+    if env_pinned:
+        return pinned
+    try:
+        from benchmarks.evidence_paths import LAYOUT_DECISION_PATH
+        return bool(json.loads(
+            LAYOUT_DECISION_PATH.read_text()).get("serial_reduce"))
+    except (OSError, ValueError):
+        return False
+
+
 class Session:
     def __init__(self, outdir: pathlib.Path, resume_after: str | None = None):
         self.outdir = outdir
@@ -86,6 +132,27 @@ class Session:
                     # relaunch fail identically forever; re-run instead.
                     continue
                 self.prior[e["step"]] = e
+        # A replayed result is credited to a LAYOUT (the kernel gate's
+        # verdict names one; bench/ca/grid/roofline numbers are layout-
+        # dependent evidence), so any step that recorded which reduction
+        # layout it ran may only replay into a launch that would run it
+        # under the same layout; on mismatch the replay is dropped and
+        # the step re-runs live (round-4 advisor finding: a re-armed
+        # launch with a different POISSON_TPU_SERIAL_REDUCE would
+        # otherwise write an affirmative layout artifact naming the
+        # wrong layout). The two explicit A/B steps run under a forced
+        # pin regardless of the ambient env.
+        env_val = os.environ.get("POISSON_TPU_SERIAL_REDUCE")
+        pinned = env_val == "1"
+        bench_pred = _predicted_bench_layout(pinned, env_val is not None)
+        forced = {"kernel_probe_serial": True, "kernel_probe_default": False}
+        for step in list(self.prior):
+            layouts = _recorded_layouts(self.prior[step].get("result"))
+            want = forced.get(
+                step, bench_pred if step.startswith("bench_") else pinned
+            )
+            if layouts and layouts != {want}:
+                del self.prior[step]
 
     def record(self, step: str, payload: dict) -> None:
         entry = {"step": step, "at": _utc(), **payload}
@@ -418,7 +485,14 @@ def _bench_value(rec, backend_name: str):
         return None
     det = rec.get("detail") or {}
     if det.get("backend") == backend_name and det.get("platform") == "tpu":
-        return rec.get("value")
+        value = rec.get("value")
+        if value is None:
+            # A hardware-labeled record with no value is malformed; say
+            # so rather than silently treating the backend as unproven
+            # (round-4 advisor finding).
+            print(f"[decide_backend_chain] hardware-labeled {backend_name} "
+                  "record excluded: no 'value' in bench result", flush=True)
+        return value
     return None
 
 
@@ -456,7 +530,8 @@ def decide_backend_chain(bench800, ca, fused_probe_ok,
     if fused_probe_ok and fused_v is None:
         fused_v = _bench_value(bench_fused_runner(), "pallas_fused")
     proven = [(name, v) for name, v in
-              (("pallas_ca", ca_v), ("pallas_fused", fused_v)) if v]
+              (("pallas_ca", ca_v), ("pallas_fused", fused_v))
+              if v is not None]
     proven.sort(key=lambda t: -t[1])
     det800 = (bench800.get("detail") or {}) if isinstance(bench800, dict) \
         else {}

@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
 from typing import NamedTuple
 
 import jax
@@ -122,7 +123,41 @@ def _resolve_serial(serial: bool | None, parallel: bool) -> bool:
             "steps; a parallel tile grid cannot honor it — pass one or "
             "the other"
         )
+    if parallel and _is_megacore_device():
+        # The partial-output layout shares ONE whole-window SMEM output
+        # across every grid step (the only Mosaic-lowerable expression —
+        # see _partial_out_spec); whether megacore write-back merges
+        # distinct cells written by different TensorCores is unverified
+        # (the target v5e is single-core, where the question cannot
+        # arise — hence the device gate). Surfaced as a warning so a
+        # megacore operator validates the golden iteration count before
+        # trusting the reductions.
+        warnings.warn(
+            "parallel tile grid + per-strip SMEM partial outputs: "
+            "cross-TensorCore write-back of the shared partial window is "
+            "unverified on megacore parts — check the golden iteration "
+            "count on this hardware before trusting the reductions",
+            RuntimeWarning, stacklevel=3,
+        )
     return serial
+
+
+def _is_megacore(platform: str, device_kind: str) -> bool:
+    """Mosaic's ``parallel`` dimension semantics splits the tile grid
+    across TensorCores only on megacore chips (two cores fused behind one
+    device: v4, v5p). Single-core parts (v5e/v6e "lite") and pre-megacore
+    chips (v2/v3 expose each core as its own device) execute the grid on
+    one core, where the shared-partial-window question cannot arise."""
+    kind = device_kind.lower()
+    return platform == "tpu" and ("v4" in kind or "v5p" in kind)
+
+
+def _is_megacore_device() -> bool:
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return _is_megacore(dev.platform, getattr(dev, "device_kind", ""))
 
 
 def strip_height(cols: int, owned_rows: int, buffers: int = 12) -> int:

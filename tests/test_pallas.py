@@ -6,6 +6,8 @@ A/B the Pallas path against them, on CPU via interpret mode (the kernels
 themselves are what runs on TPU — same trace, different executor).
 """
 
+import warnings
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -208,12 +210,43 @@ def test_column_blocked_golden_400x600():
 def test_parallel_grid_matches_sequential():
     """The parallel strip-grid option must be a pure scheduling hint: same
     iterate sequence, bit-identical solution (per-strip partials are
-    tree-summed the same way either way)."""
+    tree-summed the same way either way). On non-megacore devices (this
+    CPU run included) it must stay silent — the megacore caveat warning
+    is device-gated (round-4 advisor finding + review)."""
     p = Problem(M=40, N=40)
     r_seq = pallas_cg_solve(p)
-    r_par = pallas_cg_solve(p, parallel=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        r_par = pallas_cg_solve(p, parallel=True)
     assert int(r_par.iterations) == int(r_seq.iterations) == 50
     np.testing.assert_array_equal(np.asarray(r_par.w), np.asarray(r_seq.w))
+
+
+def test_megacore_predicate():
+    """The caveat warning fires exactly on megacore parts: two TensorCores
+    fused behind one device (v4, v5p) — not on single-core lite parts, not
+    on per-core-device v2/v3, not off-TPU."""
+    from poisson_tpu.ops.pallas_cg import _is_megacore
+    assert _is_megacore("tpu", "TPU v4")
+    assert _is_megacore("tpu", "TPU v5p")
+    assert not _is_megacore("tpu", "TPU v5 lite")
+    assert not _is_megacore("tpu", "TPU v5e")
+    assert not _is_megacore("tpu", "TPU v3")
+    assert not _is_megacore("cpu", "cpu")
+
+
+def test_megacore_parallel_partials_warns(monkeypatch):
+    """On a (faked) megacore device the parallel-grid + partial-output
+    combination announces the unverified cross-core write-back. Exercised
+    at the _resolve_serial unit — a full solve may hit the jit cache from
+    an earlier parallel=True trace and never re-run the resolution."""
+    monkeypatch.setattr(pallas_cg, "_is_megacore_device", lambda: True)
+    with pytest.warns(RuntimeWarning, match="megacore"):
+        assert pallas_cg._resolve_serial(None, True) is False
+    with warnings.catch_warnings():  # serial path never uses partials
+        warnings.simplefilter("error")
+        with pytest.raises(ValueError):
+            pallas_cg._resolve_serial(True, True)
 
 
 def test_gate_is_bit_exact():

@@ -165,6 +165,20 @@ class TestDecideBackendChain:
         got = _decide(_bench("pallas_fused", 40000.0), ca)
         assert got["chain"] == ["pallas_fused"]
 
+    def test_zero_valued_bench_is_still_evidence(self):
+        # A legitimate 0-valued record must not be dropped by a
+        # truthiness filter (round-4 advisor finding); with no xla
+        # comparison available it still proves the backend ran.
+        got = _decide(_bench("pallas_fused", 0.0), {"ok": False})
+        assert got["chain"] == ["pallas_fused"]
+        assert got["evidence"] == {"pallas_fused": 0.0}
+
+    def test_hardware_record_without_value_logged_not_silent(self, capsys):
+        rec = {"detail": {"backend": "pallas_fused", "platform": "tpu"}}
+        got = _decide(rec, {"ok": False})
+        assert got is None
+        assert "record excluded" in capsys.readouterr().out
+
 
 class TestMeasuredChainAdoption:
     @pytest.fixture()
@@ -230,6 +244,81 @@ class TestSessionResume:
         # old (stale), failed, identity (always live), and null results
         # are all excluded; only the fresh ok step replays.
         assert set(s.prior) == {"fresh"}
+
+    _LAYOUT_ENTRIES = [
+        {"step": "kernel_probe", "at": "2026-07-30T06:00:00+00:00",
+         "ok": True, "result": {"serial_reduce": True, "ok": True}},
+        {"step": "kernel_probe_serial",
+         "at": "2026-07-30T06:05:00+00:00",
+         "ok": True, "result": {"serial_reduce": True, "ok": True}},
+        # every layout-dependent step is filtered, not just the
+        # probes (review finding): a CA number measured under
+        # serial-Kahan is not evidence for a per-strip session
+        {"step": "ca_probe", "at": "2026-07-30T06:10:00+00:00",
+         "ok": True, "result": {"serial_reduce": True, "ok": True}},
+        # bench.py records the layout under detail (review finding:
+        # the filter must look there, not only at the top level) ...
+        {"step": "bench_800x1200", "at": "2026-07-30T06:15:00+00:00",
+         "ok": True, "result": {"value": 1.0, "detail":
+                                {"backend": "pallas_fused",
+                                 "serial_reduce": True}}},
+        # ... an xla-demoted bench makes no layout claim (no Pallas
+        # kernel ran; the stamp is just the ambient env) ...
+        {"step": "bench_1600x2400", "at": "2026-07-30T06:17:00+00:00",
+         "ok": True, "result": {"value": 2.0, "detail":
+                                {"backend": "xla",
+                                 "serial_reduce": True}}},
+        # ... and roofline.py nests it per solver row
+        {"step": "roofline_2400x3200", "at": "2026-07-30T06:20:00+00:00",
+         "ok": True, "result": {"solver": [{"serial_reduce": True},
+                                           {"serial_reduce": True}]}},
+        # steps that record no layout replay regardless
+        {"step": "curve_800x1200", "at": "2026-07-30T06:25:00+00:00",
+         "ok": True, "result": {"rows": 989}},
+    ]
+
+    def _session(self, tmp_path, monkeypatch, artifact=None):
+        import benchmarks.evidence_paths as ep
+
+        target = tmp_path / "layout_decision.json"
+        if artifact is not None:
+            target.write_text(json.dumps(artifact))
+        monkeypatch.setattr(ep, "LAYOUT_DECISION_PATH", target)
+        outdir = self._mklog(tmp_path, self._LAYOUT_ENTRIES)
+        return tpu_session.Session(
+            outdir, resume_after="2026-07-30T00:00:00+00:00"
+        )
+
+    def test_replayed_layout_mismatch_is_dropped(self, tmp_path,
+                                                 monkeypatch):
+        # Steps recorded under serial-Kahan must not replay into a
+        # launch that would run them per-strip: the gate would credit
+        # the wrong layout and the evidence the wrong provenance
+        # (round-4 advisor finding + review). Matching env: all stand.
+        monkeypatch.delenv("POISSON_TPU_SERIAL_REDUCE", raising=False)
+        s = self._session(tmp_path, monkeypatch)
+        # env pins per-strip, no artifact: every serial-run Pallas step
+        # is dropped wherever it recorded its layout; the explicitly-
+        # serial A/B step, the layout-free curve step, and the
+        # xla-demoted bench keep their replays.
+        assert set(s.prior) == {"kernel_probe_serial", "bench_1600x2400",
+                                "curve_800x1200"}
+        monkeypatch.setenv("POISSON_TPU_SERIAL_REDUCE", "1")
+        s = self._session(tmp_path, monkeypatch)
+        assert set(s.prior) == {e["step"] for e in self._LAYOUT_ENTRIES}
+
+    def test_bench_replay_honors_adopted_artifact(self, tmp_path,
+                                                  monkeypatch):
+        # bench.py adopts layout_decision.json when the env is unset, so
+        # a serial-recorded bench replay IS what a live re-run would
+        # measure when the artifact says serial — dropping it would burn
+        # the window re-measuring identical numbers (review finding).
+        # Probes and rooflines read the env only and are still dropped.
+        monkeypatch.delenv("POISSON_TPU_SERIAL_REDUCE", raising=False)
+        s = self._session(tmp_path, monkeypatch,
+                          artifact={"serial_reduce": True, "reason": "ab"})
+        assert set(s.prior) == {"kernel_probe_serial", "bench_800x1200",
+                                "bench_1600x2400", "curve_800x1200"}
 
     def test_no_resume_means_no_prior(self, tmp_path):
         outdir = self._mklog(tmp_path, [
