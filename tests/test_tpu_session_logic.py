@@ -218,6 +218,22 @@ class TestMeasuredChainAdoption:
         self._write(bench_mod, content)
         assert bench_mod._measured_chain() is None
 
+    def test_per_grid_good_paths(self, bench_mod):
+        # Every published grid gets its own committed high-water-mark
+        # artifact; the flagship keeps the legacy name (driver contract).
+        assert bench_mod._grid_good_path(800, 1200) is bench_mod.GOOD_PATH
+        assert bench_mod._grid_good_path(1600, 2400).name == \
+            "BENCH_TPU_GOOD_1600x2400.json"
+        assert bench_mod._grid_good_path(2400, 3200).name == \
+            "BENCH_TPU_GOOD_2400x3200.json"
+
+    def test_read_good_takes_a_path(self, bench_mod, tmp_path):
+        p = tmp_path / "g.json"
+        p.write_text(json.dumps({"value": 5.0}))
+        got = bench_mod._read_good(p)
+        assert got["last"]["value"] == 5.0 and got["best"]["value"] == 5.0
+        assert bench_mod._read_good(tmp_path / "missing.json") == {}
+
 
 class TestSessionResume:
     def _mklog(self, tmp_path, entries):
