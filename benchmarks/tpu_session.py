@@ -419,6 +419,41 @@ print(json.dumps({
 }))
 """
 
+_CA_SHARDED_1X1 = r"""
+import json
+from poisson_tpu.utils.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax
+from poisson_tpu.config import Problem
+from poisson_tpu.parallel import make_solver_mesh
+from poisson_tpu.parallel.pallas_ca_sharded import ca_cg_solve_sharded
+from poisson_tpu.analysis import l2_error_host
+from poisson_tpu.utils.timing import fence, mlups
+import time
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev.platform
+mesh = make_solver_mesh(jax.devices()[:1], grid=(1, 1))
+problem = Problem(M=800, N=1200)
+t0 = time.perf_counter()
+res = ca_cg_solve_sharded(problem, mesh, interpret=False)
+fence(res.iterations)
+first = time.perf_counter() - t0
+t0 = time.perf_counter()
+res = ca_cg_solve_sharded(problem, mesh, interpret=False)
+fence(res.iterations)
+solve = time.perf_counter() - t0
+print(json.dumps({
+    "backend": "pallas_ca_sharded(masked, Mosaic)", "mesh": [1, 1],
+    "grid": [800, 1200], "iterations": int(res.iterations),
+    "golden": 989, "l2_error": l2_error_host(problem, res.w),
+    "compile_and_first_s": round(first, 2),
+    "solve_s": round(solve, 4),
+    "mlups": round(mlups(problem, int(res.iterations), solve), 1),
+    "device_kind": dev.device_kind,
+}))
+"""
+
 _BIG_GRID = r"""
 import json, sys, time, dataclasses
 from poisson_tpu.utils.platform import honor_jax_platforms_env
@@ -724,6 +759,12 @@ def main() -> int:
     # round-1 ask that repeatedly lost its window to later-step ordering;
     # cheap, so it runs right after the benches.
     s.run("sharded_1x1_mosaic", [py, "-c", _SHARDED_1X1],
+          timeout=1200, parse_json_tail=True)
+
+    # 3.2 the sharded CA variant on the real chip (1x1 mesh): Mosaic-
+    # compiles the ±2-band masked CA kernels + width-2 ring exchange —
+    # the round-5 sharded-CA build's hardware verdict.
+    s.run("ca_sharded_1x1_mosaic", [py, "-c", _CA_SHARDED_1X1],
           timeout=1200, parse_json_tail=True)
 
     # 3.5 communication-avoiding pair-iteration: golden + L2 on the

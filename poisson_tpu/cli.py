@@ -58,12 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="iteration cap (default (M-1)(N-1))")
     p.add_argument("--backend",
                    choices=("auto", "xla", "pallas", "pallas-ca", "sharded",
-                            "pallas-sharded", "native"),
+                            "pallas-sharded", "pallas-ca-sharded", "native"),
                    default="auto",
                    help="auto: pallas-sharded on >1 TPU, sharded on >1 CPU "
-                        "device, pallas on 1 TPU, else xla. pallas-ca: the "
-                        "communication-avoiding s=2 pair iteration "
-                        "(single-device, fp32, full-width; opt-in)")
+                        "device, pallas on 1 TPU, else xla. pallas-ca[-"
+                        "sharded]: the communication-avoiding s=2 pair "
+                        "iteration (fp32, full-width; opt-in), single-device "
+                        "or over the mesh with width-2 halos")
     p.add_argument("--mesh", type=_parse_mesh, default=None, metavar="PXxPY",
                    help="device mesh shape for --backend sharded (default: "
                         "near-square over all devices)")
@@ -180,7 +181,7 @@ def _run_jax(args, problem: Problem, backend: str):
     mesh_shape: Optional[tuple[int, int]] = None
     devices = jax.devices()
 
-    if backend in ("sharded", "pallas-sharded"):
+    if backend in ("sharded", "pallas-sharded", "pallas-ca-sharded"):
         from poisson_tpu.parallel import (
             make_solver_mesh,
             pallas_cg_solve_sharded,
@@ -193,7 +194,31 @@ def _run_jax(args, problem: Problem, backend: str):
         else:
             mesh = make_solver_mesh()
         mesh_shape = (mesh.shape["x"], mesh.shape["y"])
-        if backend == "pallas-sharded":
+        if backend == "pallas-ca-sharded":
+            if args.dtype == "float64":
+                raise SystemExit(
+                    "--backend pallas-ca-sharded is the fp32 fused path; "
+                    "use --backend sharded for float64"
+                )
+            if args.setup == "device":
+                raise SystemExit(
+                    "--backend pallas-ca-sharded builds its canvases on "
+                    "the host; use --backend sharded for --setup device"
+                )
+            if args.checkpoint:
+                raise SystemExit(
+                    "--backend pallas-ca-sharded has no checkpointed "
+                    "driver; checkpoints are cross-algorithm portable — "
+                    "use --backend pallas-sharded (or pallas-ca "
+                    "single-device) with --checkpoint"
+                )
+            from poisson_tpu.parallel import ca_cg_solve_sharded
+
+            run = lambda: ca_cg_solve_sharded(
+                problem, mesh, bm=args.bm,
+                parallel=args.parallel_grid, serial=args.serial_reduce,
+            )
+        elif backend == "pallas-sharded":
             if args.dtype == "float64":
                 raise SystemExit(
                     "--backend pallas-sharded is the fp32 fused path; use "
@@ -312,7 +337,8 @@ def _run_jax(args, problem: Problem, backend: str):
 
     dtype_name = (
         "float32"
-        if backend in ("pallas", "pallas-ca", "pallas-sharded")
+        if backend in ("pallas", "pallas-ca", "pallas-sharded",
+                       "pallas-ca-sharded")
         else resolve_dtype(args.dtype)
     )
     report = solve_report(
@@ -418,21 +444,22 @@ def main(argv=None) -> int:
                 f"(resolved backend: {backend})"
             )
         if args.parallel_grid and backend not in (
-            "pallas", "pallas-ca", "pallas-sharded"
+            "pallas", "pallas-ca", "pallas-sharded", "pallas-ca-sharded"
         ):
             raise SystemExit(
                 f"--parallel-grid applies to the pallas backends "
                 f"(resolved backend: {backend})"
             )
         if args.bm is not None and backend not in (
-            "pallas", "pallas-ca", "pallas-sharded"
+            "pallas", "pallas-ca", "pallas-sharded", "pallas-ca-sharded"
         ):
             raise SystemExit(
                 f"--bm applies to the pallas backends "
                 f"(resolved backend: {backend})"
             )
         if args.serial_reduce is not None:
-            if backend not in ("pallas", "pallas-ca", "pallas-sharded"):
+            if backend not in ("pallas", "pallas-ca", "pallas-sharded",
+                               "pallas-ca-sharded"):
                 raise SystemExit(
                     f"--serial-reduce/--no-serial-reduce applies to the "
                     f"pallas backends (resolved backend: {backend})"

@@ -51,10 +51,15 @@ Hardware measurement pending: ``benchmarks/tpu_session.py``'s
 ``ca_probe`` step captures it on the next healthy tunnel window
 (BENCH.md records CPU/XLA validation only until then).
 
-Single-device, full-width canvases only (the published grids' geometry).
-The sharded variant needs width-2 halos (t2 at a shard edge reaches ±2)
-and is future work; ``parallel.pallas_sharded`` remains the distributed
-path.
+Full-width canvases only (the published grids' geometry). The kernels
+serve two callers: the single-device drivers below, and the distributed
+variant (``parallel.pallas_ca_sharded``), which runs the same sweeps per
+shard with ``band`` widened ±2 rows and a ``colmask`` on the unweighted
+Gram partials — the double stencil application reaches two cells past a
+shard edge, so the sharded driver maintains width-2 halo rings (the
+fused path's width-1 ``r``-ring induction does not extend to s=2:
+reconstructing p₁'s halo locally would need t1 there, which needs pn on
+a ring that grows by one per pair).
 """
 
 from __future__ import annotations
@@ -74,6 +79,7 @@ from poisson_tpu.ops.pallas_cg import (
     Canvas,
     _block_spec,
     _canvas_shape,
+    _colmask_spec,
     _grid_params,
     _kahan_add,
     _resolve_serial,
@@ -119,7 +125,9 @@ def _stencil(pn, cs, cw, g, lo, hi):
     )
 
 
-def _make_basis_kernel(cv: Canvas, serial: bool):
+def _make_basis_kernel(cv: Canvas, serial: bool,
+                       band: tuple[int, int] | None = None,
+                       masked: bool = False):
     """Kernel C. Outputs pn, t1, t2, t3 (center blocks) + Gram partials.
 
     The strip's center rows are [HALO, HALO+bm). t1 is needed on
@@ -128,12 +136,26 @@ def _make_basis_kernel(cv: Canvas, serial: bool):
     for the direction update, extended one application deeper. All
     canvases are zero outside the interior, so the extended rows compute
     correct (zero) values at the grid boundary without masking.
+
+    ``band`` is the canvas-row range [lo, hi) on which the direction
+    update is live (single-device: the interior band). The sharded
+    caller widens it ±2 rows so pn is real on the shard's width-2 halo
+    ring — t1 on ±1 (feeding t2 at the shard edge) then reads exchanged
+    neighbour data, not zeros. ``masked`` adds a (1, C) column-mask
+    operand multiplying the six unweighted Gram partials: sharded
+    canvases carry real neighbour values in their halo columns, which
+    must not enter owned-interior reductions (the six sc²-weighted
+    partials need no mask — the sharded builder already restricts sc² to
+    the owned interior, exactly like the fused path).
     """
     h = HALO
-    band_lo, band_hi = h, cv.rows - h
+    band_lo, band_hi = band if band is not None else (h, cv.rows - h)
 
     def kernel(beta_ref, pprev_ref, r_ref, cs_ref, cw_ref, g_ref, sc2_ref,
                *rest):
+        colmask_ref = None
+        if masked:
+            colmask_ref, *rest = rest
         comp_ref = None
         if serial:
             *rest, comp_ref = rest
@@ -166,19 +188,26 @@ def _make_basis_kernel(cv: Canvas, serial: bool):
         pn_c = pn[h:-h, :]
         r_c = r[h:-h, :]
         sc2 = sc2_ref[:]
+        mask = colmask_ref[:] if masked else None
 
         pn_ref[:] = pn_c
         t1_ref[:] = t1
         t2_ref[:] = t2
         t3_ref[:] = t3
 
+        def plain(u, v):
+            uv = u * v
+            if masked:
+                uv = uv * mask
+            return jnp.sum(uv, dtype=jnp.float32)
+
         sums = (
-            jnp.sum(pn_c * t1, dtype=jnp.float32),    # a1
-            jnp.sum(t1 * t1, dtype=jnp.float32),      # b1
-            jnp.sum(r_c * t1, dtype=jnp.float32),     # e
-            jnp.sum(r_c * t3, dtype=jnp.float32),     # f
-            jnp.sum(t1 * t3, dtype=jnp.float32),      # g
-            jnp.sum(t1 * t2, dtype=jnp.float32),      # h
+            plain(pn_c, t1),                          # a1
+            plain(t1, t1),                            # b1
+            plain(r_c, t1),                           # e
+            plain(r_c, t3),                           # f
+            plain(t1, t3),                            # g
+            plain(t1, t2),                            # h
             jnp.sum(pn_c * pn_c * sc2, dtype=jnp.float32),   # wpp
             jnp.sum(pn_c * r_c * sc2, dtype=jnp.float32),    # wpr
             jnp.sum(pn_c * t1 * sc2, dtype=jnp.float32),     # wpt
@@ -205,12 +234,18 @@ def _make_basis_kernel(cv: Canvas, serial: bool):
     return kernel
 
 
-def _make_pair_update_kernel(cv: Canvas, serial: bool):
+def _make_pair_update_kernel(cv: Canvas, serial: bool,
+                             masked: bool = False):
     """Kernel D. Scalars arrive as a (1, 8) SMEM row:
-    [c_p, a2, a2a1, alpha1, beta1, 0, 0, 0] (padded for alignment)."""
+    [c_p, a2, a2a1, alpha1, beta1, 0, 0, 0] (padded for alignment).
+    ``masked`` adds a (1, C) column mask on the Σr'² partial (sharded
+    canvases carry neighbour values in halo columns)."""
 
-    def kernel(coef_ref, pn_ref, t1_ref, t2_ref, t3_ref, x_ref, r_ref,
-               *rest):
+    def kernel(coef_ref, pn_ref, t1_ref, t2_ref, t3_ref, *rest):
+        colmask_ref = None
+        if masked:
+            colmask_ref, *rest = rest
+        x_ref, r_ref, *rest = rest
         comp_ref = None
         if serial:
             *rest, comp_ref = rest
@@ -227,7 +262,10 @@ def _make_pair_update_kernel(cv: Canvas, serial: bool):
         x_out_ref[:] = x_ref[:] + c_p * pn + a2 * r - a2a1 * t1
         r_out_ref[:] = r_new
         p1_ref[:] = r - alpha1 * t1 + beta1 * pn
-        part = jnp.sum(r_new * r_new, dtype=jnp.float32)
+        rr2 = r_new * r_new
+        if masked:
+            rr2 = rr2 * colmask_ref[:]
+        part = jnp.sum(rr2, dtype=jnp.float32)
         if serial:
             _kahan_add(pl.program_id(0) == 0, rr_ref, comp_ref, 0, part)
         else:
@@ -255,22 +293,32 @@ def _gram_out_spec(serial: bool, nb: int):
 
 def basis_sweep(cv: Canvas, beta, pprev, r, cs, cw, g, sc2, *,
                 interpret: bool, parallel: bool = False,
-                serial: bool | None = None):
-    """pn, t1, t2, t3, Gram partials — one HBM sweep (kernel C)."""
+                serial: bool | None = None,
+                band: tuple[int, int] | None = None, colmask=None):
+    """pn, t1, t2, t3, Gram partials — one HBM sweep (kernel C).
+
+    ``band``/``colmask`` select the sharded variant (see the kernel
+    factory); defaults are the single-device interior band, no mask."""
     serial = _resolve_serial(serial, parallel)
+    masked = colmask is not None
     gram_spec, gram_shape = _gram_out_spec(serial, cv.nb)
+    in_specs = [
+        _scalar_spec(),
+        _strip_in_spec(cv),   # p_prev
+        _strip_in_spec(cv),   # r
+        _strip_in_spec(cv),   # cs
+        _strip_in_spec(cv),   # cw (±1 rows feed the double apply)
+        _strip_in_spec(cv),   # g  (ditto)
+        _block_spec(cv),      # sc2 (center-only, weighted Gram)
+    ]
+    operands = [beta, pprev, r, cs, cw, g, sc2]
+    if masked:
+        in_specs.append(_colmask_spec(cv))
+        operands.append(colmask)
     return pl.pallas_call(
-        _make_basis_kernel(cv, serial),
+        _make_basis_kernel(cv, serial, band, masked),
         grid=(cv.nb,),
-        in_specs=[
-            _scalar_spec(),
-            _strip_in_spec(cv),   # p_prev
-            _strip_in_spec(cv),   # r
-            _strip_in_spec(cv),   # cs
-            _strip_in_spec(cv),   # cw (±1 rows feed the double apply)
-            _strip_in_spec(cv),   # g  (ditto)
-            _block_spec(cv),      # sc2 (center-only, weighted Gram)
-        ],
+        in_specs=in_specs,
         out_specs=[
             _block_spec(cv), _block_spec(cv), _block_spec(cv),
             _block_spec(cv), gram_spec,
@@ -287,14 +335,15 @@ def basis_sweep(cv: Canvas, beta, pprev, r, cs, cw, g, sc2, *,
         ),
         interpret=interpret,
         **_grid_params(parallel),
-    )(beta, pprev, r, cs, cw, g, sc2)
+    )(*operands)
 
 
 def pair_update(cv: Canvas, coefs, pn, t1, t2, t3, x, r, *,
                 interpret: bool, parallel: bool = False,
-                serial: bool | None = None):
+                serial: bool | None = None, colmask=None):
     """x', r', p₁, Σr'² partials — one HBM sweep (kernel D)."""
     serial = _resolve_serial(serial, parallel)
+    masked = colmask is not None
     # Whole-array SMEM windows (strip i writes its own cell in-kernel;
     # see _gram_out_spec / ops.pallas_cg._partial_out_spec for why the
     # per-cell block maps they replace could not lower for nb > 1).
@@ -303,18 +352,24 @@ def pair_update(cv: Canvas, coefs, pn, t1, t2, t3, x, r, *,
                                     jnp.float32)
     coef_spec = pl.BlockSpec((1, 8), lambda i: (0, 0),
                              memory_space=pltpu.SMEM)
+    in_specs = [
+        coef_spec,
+        _block_spec(cv),   # pn
+        _block_spec(cv),   # t1
+        _block_spec(cv),   # t2
+        _block_spec(cv),   # t3
+    ]
+    operands = [coefs, pn, t1, t2, t3]
+    if masked:
+        in_specs.append(_colmask_spec(cv))
+        operands.append(colmask)
+    x_idx = len(operands)
+    in_specs += [_block_spec(cv), _block_spec(cv)]
+    operands += [x, r]
     return pl.pallas_call(
-        _make_pair_update_kernel(cv, serial),
+        _make_pair_update_kernel(cv, serial, masked),
         grid=(cv.nb,),
-        in_specs=[
-            coef_spec,
-            _block_spec(cv),   # pn
-            _block_spec(cv),   # t1
-            _block_spec(cv),   # t2
-            _block_spec(cv),   # t3
-            _block_spec(cv),   # x
-            _block_spec(cv),   # r
-        ],
+        in_specs=in_specs,
         out_specs=[_block_spec(cv), _block_spec(cv), _block_spec(cv),
                    rr_spec],
         out_shape=[
@@ -323,11 +378,11 @@ def pair_update(cv: Canvas, coefs, pn, t1, t2, t3, x, r, *,
             _canvas_shape(cv, x.dtype),
             rr_shape,
         ],
-        input_output_aliases={5: 0, 6: 1},   # x → x', r → r'
+        input_output_aliases={x_idx: 0, x_idx + 1: 1},   # x → x', r → r'
         scratch_shapes=([pltpu.SMEM((1,), jnp.float32)] if serial else []),
         interpret=interpret,
         **_grid_params(parallel),
-    )(coefs, pn, t1, t2, t3, x, r)
+    )(*operands)
 
 
 class _CAState(NamedTuple):
@@ -341,11 +396,102 @@ class _CAState(NamedTuple):
     diff: jnp.ndarray
 
 
-def _make_ca_body(problem: Problem, cv: Canvas, interpret: bool,
-                  cs, cw, g, sc2, dtype, parallel: bool, serial: bool):
+class PairDecision(NamedTuple):
+    """Everything the pair-update sweep and the state assembly need, from
+    one pair's (globally summed) Gram vector — shared by the
+    single-device and sharded bodies so their scalar recurrences are
+    identical by construction."""
+
+    coefs: jnp.ndarray   # (1, 8) kernel-D scalar row
+    only1: jnp.ndarray
+    stop1: jnp.ndarray
+    deg2: jnp.ndarray
+    short: jnp.ndarray   # this pair advanced k by 1, not 2
+    rr1: jnp.ndarray
+    diff1: jnp.ndarray
+    diff2: jnp.ndarray
+
+
+def pair_scalars(problem: Problem, rr, k, gsum, dtype) -> PairDecision:
+    """The α/β/convergence recurrences for one CA pair (module doc).
+
+    ``gsum`` is the (12,) Gram vector already summed over strips (and,
+    in the sharded caller, psum'd over the mesh) and scaled by h1·h2;
+    ``rr`` = ⟨r, r⟩·h1h2 carried from the previous pair."""
     h1h2 = jnp.float32(problem.h1 * problem.h2)
     norm_w = h1h2 if problem.weighted_norm else jnp.float32(1.0)
     delta = jnp.float32(problem.delta)
+    a1, b1, e, f, gg, hh = (gsum[j] for j in range(6))
+    wpp, wpr, wpt, wrr, wrt, wtt = (gsum[6 + j] for j in range(6))
+
+    deg1 = jnp.abs(a1) < _DENOM_TOL
+    alpha1 = jnp.where(deg1, 0.0, rr / jnp.where(deg1, 1.0, a1))
+    diff1 = jnp.abs(alpha1) * jnp.sqrt(
+        jnp.maximum(wpp * norm_w / h1h2, 0.0)
+    )
+    rr1 = jnp.maximum(rr - 2 * alpha1 * e + alpha1 * alpha1 * b1, 0.0)
+    beta1 = rr1 / jnp.where(rr == 0.0, 1.0, rr)
+    rAr1 = f - 2 * alpha1 * gg + alpha1 * alpha1 * hh
+    pAr1 = e - alpha1 * b1
+    p1Ap1 = rAr1 + 2 * beta1 * pAr1 + beta1 * beta1 * a1
+    deg2 = jnp.abs(p1Ap1) < _DENOM_TOL
+    alpha2 = jnp.where(deg2, 0.0, rr1 / jnp.where(deg2, 1.0, p1Ap1))
+    w11 = wrr - 2 * alpha1 * wrt + alpha1 * alpha1 * wtt
+    w1p = wpr - alpha1 * wpt
+    wp1p1 = w11 + 2 * beta1 * w1p + beta1 * beta1 * wpp
+    diff2 = jnp.abs(alpha2) * jnp.sqrt(
+        jnp.maximum(wp1p1 * norm_w / h1h2, 0.0)
+    )
+
+    stop1 = deg1 | (diff1 < delta)
+    cap_stop = k + 1 >= problem.iteration_cap
+    # Apply only the first inner step when: it converged (stop1), the
+    # second step is degenerate (deg2 — its α would be garbage), or
+    # the iteration cap allows exactly one more step (the 2-sweep
+    # path reports iterations == cap exactly; so must this one).
+    only1 = stop1 | deg2 | cap_stop
+    a2 = jnp.where(only1, 0.0, alpha2)
+    c_p = alpha1 + a2 * beta1
+    coefs = jnp.stack(
+        [c_p, a2, a2 * alpha1, alpha1, beta1,
+         jnp.float32(0), jnp.float32(0), jnp.float32(0)]
+    ).reshape(1, 8).astype(dtype)
+    return PairDecision(
+        coefs=coefs, only1=only1, stop1=stop1, deg2=deg2,
+        short=stop1 | cap_stop, rr1=rr1, diff1=diff1, diff2=diff2,
+    )
+
+
+def assemble_pair_state(problem: Problem, s: _CAState, d: PairDecision,
+                        x, r, pprev, rr2) -> _CAState:
+    """Post-sweep state assembly, shared with the sharded body.
+
+    When only step 1 was applied, the direction material for the next
+    sweep is pn (with β = rr₂/rr), not p₁ — which keeps a cap-truncated
+    pair mathematically identical to the 2-sweep path's state at the
+    same k. k/diff mirror the 2-sweep path exactly, including the (never
+    observed for this SPD system) degenerate second step: the 2-sweep
+    loop COUNTS the degenerate iteration with α=0 and diff=0, so deg2
+    increments by 2 and reports 0 — only a converged or cap-truncated
+    first step increments by 1."""
+    rr_prev = jnp.where(d.only1, s.rr, d.rr1)
+    delta = jnp.float32(problem.delta)
+    return _CAState(
+        k=s.k + jnp.where(d.short, 1, 2).astype(jnp.int32),
+        done=d.stop1 | d.deg2 | ((~d.only1) & (d.diff2 < delta)),
+        x=x, r=r,
+        pprev=pprev,
+        rr=rr2,
+        beta=rr2 / jnp.where(rr_prev == 0.0, 1.0, rr_prev),
+        diff=jnp.where(
+            d.short, d.diff1, jnp.where(d.deg2, jnp.float32(0.0), d.diff2)
+        ),
+    )
+
+
+def _make_ca_body(problem: Problem, cv: Canvas, interpret: bool,
+                  cs, cw, g, sc2, dtype, parallel: bool, serial: bool):
+    h1h2 = jnp.float32(problem.h1 * problem.h2)
 
     def body(s: _CAState) -> _CAState:
         beta = jnp.reshape(s.beta, (1, 1)).astype(dtype)
@@ -354,69 +500,14 @@ def _make_ca_body(problem: Problem, cv: Canvas, interpret: bool,
             interpret=interpret, parallel=parallel, serial=serial,
         )
         gsum = jnp.sum(gram, axis=0) * h1h2
-        a1, b1, e, f, gg, hh = (gsum[j] for j in range(6))
-        wpp, wpr, wpt, wrr, wrt, wtt = (gsum[6 + j] for j in range(6))
-
-        deg1 = jnp.abs(a1) < _DENOM_TOL
-        alpha1 = jnp.where(deg1, 0.0, s.rr / jnp.where(deg1, 1.0, a1))
-        diff1 = jnp.abs(alpha1) * jnp.sqrt(
-            jnp.maximum(wpp * norm_w / h1h2, 0.0)
-        )
-        rr1 = jnp.maximum(s.rr - 2 * alpha1 * e + alpha1 * alpha1 * b1, 0.0)
-        beta1 = rr1 / jnp.where(s.rr == 0.0, 1.0, s.rr)
-        rAr1 = f - 2 * alpha1 * gg + alpha1 * alpha1 * hh
-        pAr1 = e - alpha1 * b1
-        p1Ap1 = rAr1 + 2 * beta1 * pAr1 + beta1 * beta1 * a1
-        deg2 = jnp.abs(p1Ap1) < _DENOM_TOL
-        alpha2 = jnp.where(deg2, 0.0, rr1 / jnp.where(deg2, 1.0, p1Ap1))
-        w11 = wrr - 2 * alpha1 * wrt + alpha1 * alpha1 * wtt
-        w1p = wpr - alpha1 * wpt
-        wp1p1 = w11 + 2 * beta1 * w1p + beta1 * beta1 * wpp
-        diff2 = jnp.abs(alpha2) * jnp.sqrt(
-            jnp.maximum(wp1p1 * norm_w / h1h2, 0.0)
-        )
-
-        stop1 = deg1 | (diff1 < delta)
-        cap_stop = s.k + 1 >= problem.iteration_cap
-        # Apply only the first inner step when: it converged (stop1), the
-        # second step is degenerate (deg2 — its α would be garbage), or
-        # the iteration cap allows exactly one more step (the 2-sweep
-        # path reports iterations == cap exactly; so must this one).
-        only1 = stop1 | deg2 | cap_stop
-        a2 = jnp.where(only1, 0.0, alpha2)
-        c_p = alpha1 + a2 * beta1
-        coefs = jnp.stack(
-            [c_p, a2, a2 * alpha1, alpha1, beta1,
-             jnp.float32(0), jnp.float32(0), jnp.float32(0)]
-        ).reshape(1, 8).astype(dtype)
+        d = pair_scalars(problem, s.rr, s.k, gsum, dtype)
         x, r, p1, rr_part = pair_update(
-            cv, coefs, pn, t1, t2, t3, s.x, s.r,
+            cv, d.coefs, pn, t1, t2, t3, s.x, s.r,
             interpret=interpret, parallel=parallel, serial=serial,
         )
         rr2 = jnp.sum(rr_part) * h1h2
-        rr_prev = jnp.where(only1, s.rr, rr1)
-        beta2 = rr2 / jnp.where(rr_prev == 0.0, 1.0, rr_prev)
-        # When only step 1 was applied, the direction material for the
-        # next sweep is pn (with β = rr₂/rr), not p₁ — which keeps a
-        # cap-truncated pair mathematically identical to the 2-sweep
-        # path's state at the same k.
-        done = stop1 | deg2 | ((~only1) & (diff2 < delta))
-        # k/diff mirror the 2-sweep path exactly, including the (never
-        # observed for this SPD system) degenerate second step: the
-        # 2-sweep loop COUNTS the degenerate iteration with α=0 and
-        # diff=0, so deg2 increments by 2 and reports 0 — only a
-        # converged or cap-truncated first step increments by 1.
-        short = stop1 | cap_stop
-        return _CAState(
-            k=s.k + jnp.where(short, 1, 2).astype(jnp.int32),
-            done=done,
-            x=x, r=r,
-            pprev=jnp.where(only1, pn, p1),
-            rr=rr2,
-            beta=beta2,
-            diff=jnp.where(
-                short, diff1, jnp.where(deg2, jnp.float32(0.0), diff2)
-            ),
+        return assemble_pair_state(
+            problem, s, d, x, r, jnp.where(d.only1, pn, p1), rr2
         )
 
     return body

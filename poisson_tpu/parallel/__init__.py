@@ -3,6 +3,7 @@ from poisson_tpu.parallel.mesh import choose_process_grid, make_solver_mesh
 from poisson_tpu.parallel.pcg_sharded import pcg_solve_sharded
 
 __all__ = [
+    "ca_cg_solve_sharded",
     "choose_process_grid",
     "make_solver_mesh",
     "pallas_cg_solve_sharded",
@@ -20,4 +21,8 @@ def __getattr__(name):
         from poisson_tpu.parallel import pallas_sharded
 
         return getattr(pallas_sharded, name)
+    if name == "ca_cg_solve_sharded":
+        from poisson_tpu.parallel import pallas_ca_sharded
+
+        return pallas_ca_sharded.ca_cg_solve_sharded
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -136,3 +136,23 @@ def test_pallas_checkpoint_cli(capsys, tmp_path):
     assert main(["40", "40", "--backend", "pallas", "--checkpoint", ck,
                  "--chunk", "10", "--json"]) == 0
     assert _json_line(capsys)["iterations"] == 50
+
+
+def test_ca_sharded_backend_cli(capsys):
+    """--backend pallas-ca-sharded reaches the distributed CA path
+    (interpret on the virtual CPU mesh) with its geometry flags."""
+    assert main(["40", "40", "--backend", "pallas-ca-sharded",
+                 "--mesh", "2x2", "--bm", "16", "--json"]) == 0
+    line = _json_line(capsys)
+    assert line["iterations"] == 50
+    assert line["mesh"] == [2, 2]
+    assert line["dtype"] == "float32"
+
+
+def test_ca_sharded_checkpoint_rejected():
+    """No checkpointed driver on the sharded CA path: the CLI must say so
+    (and point at the portable cross-algorithm alternative) rather than
+    silently ignore --checkpoint."""
+    with pytest.raises(SystemExit, match="cross-algorithm"):
+        main(["40", "40", "--backend", "pallas-ca-sharded",
+              "--checkpoint", "/tmp/nope.npz"])

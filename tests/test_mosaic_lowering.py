@@ -9,9 +9,11 @@ kernel variant × reduction-layout combination is caught in the CPU suite
 before any driver or TPU session becomes the first Mosaic contact.
 
 Coverage: the fused 2-sweep kernels (full-width, column-blocked, parallel
-tile grid), the communication-avoiding s=2 kernels, and the masked sharded
-kernels under ``shard_map`` (1×1 — the exact driver-session configuration —
-and 2×2 with halo exchange), each in both reduction-partial layouts
+tile grid), the communication-avoiding s=2 kernels (single-device, and
+sharded with the ±2 band + column mask under ``shard_map``), and the masked
+sharded fused kernels under ``shard_map`` (1×1 — the exact driver-session
+configuration — and 2×2 with halo exchange), each in both reduction-partial
+layouts
 (per-strip ``(nb, 1)`` partials vs serial-Kahan) where the combination is
 legal (the parallel tile grid requires the partial layout;
 ``_resolve_serial`` raises on the contradiction).
@@ -29,7 +31,7 @@ import pytest
 from poisson_tpu.config import Problem
 from poisson_tpu.ops import pallas_ca, pallas_cg
 from poisson_tpu.parallel import make_solver_mesh
-from poisson_tpu.parallel import pallas_sharded
+from poisson_tpu.parallel import pallas_ca_sharded, pallas_sharded
 
 @pytest.fixture(autouse=True)
 def _x64_off():
@@ -133,6 +135,33 @@ def test_sharded_masked_lowers(grid, serial):
     _export_tpu(
         lambda cs, cw, g, rhs, sc2, sc_int, colmask:
         pallas_sharded._solve(
+            p, mesh, spec, False, cs, cw, g, rhs, sc2, sc_int, colmask,
+            False, serial,
+        ),
+        cs, cw, g, rhs, sc2, sc_int, colmask,
+    )
+
+
+@pytest.mark.parametrize("serial", [False, True],
+                         ids=["partials", "serial-kahan"])
+@pytest.mark.parametrize("grid", [(1, 1), (2, 2)],
+                         ids=["mesh1x1", "mesh2x2"])
+def test_ca_sharded_masked_lowers(grid, serial):
+    # The CA kernels with band widened ±2 and the column mask, under
+    # shard_map with the width-2 ring exchange — the sharded-CA
+    # configuration × both reduction layouts.
+    p = Problem(M=40, N=40)
+    px, py = grid
+    mesh = make_solver_mesh(jax.devices()[: px * py], grid=grid)
+    spec = pallas_ca_sharded.ca_shard_spec(p, px, py, bm=8)  # multi-strip
+    assert spec.cv.nb > 1
+    (cs, cw, g, rhs, sc2, sc_int,
+     colmask) = pallas_ca_sharded._ca_shard_canvases(
+        p, px, py, spec, "float32"
+    )
+    _export_tpu(
+        lambda cs, cw, g, rhs, sc2, sc_int, colmask:
+        pallas_ca_sharded._ca_solve_sharded(
             p, mesh, spec, False, cs, cw, g, rhs, sc2, sc_int, colmask,
             False, serial,
         ),
