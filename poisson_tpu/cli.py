@@ -206,18 +206,22 @@ def _run_jax(args, problem: Problem, backend: str):
                     "the host; use --backend sharded for --setup device"
                 )
             if args.checkpoint:
-                raise SystemExit(
-                    "--backend pallas-ca-sharded has no checkpointed "
-                    "driver; checkpoints are cross-algorithm portable — "
-                    "use --backend pallas-sharded (or pallas-ca "
-                    "single-device) with --checkpoint"
+                from poisson_tpu.parallel.pallas_ca_sharded import (
+                    ca_cg_solve_sharded_checkpointed,
                 )
-            from poisson_tpu.parallel import ca_cg_solve_sharded
 
-            run = lambda: ca_cg_solve_sharded(
-                problem, mesh, bm=args.bm,
-                parallel=args.parallel_grid, serial=args.serial_reduce,
-            )
+                run = lambda: ca_cg_solve_sharded_checkpointed(
+                    problem, mesh, args.checkpoint, chunk=args.chunk,
+                    bm=args.bm, parallel=args.parallel_grid,
+                    serial=args.serial_reduce,
+                )
+            else:
+                from poisson_tpu.parallel import ca_cg_solve_sharded
+
+                run = lambda: ca_cg_solve_sharded(
+                    problem, mesh, bm=args.bm,
+                    parallel=args.parallel_grid, serial=args.serial_reduce,
+                )
         elif backend == "pallas-sharded":
             if args.dtype == "float64":
                 raise SystemExit(

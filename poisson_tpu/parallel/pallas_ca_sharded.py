@@ -337,3 +337,122 @@ def ca_cg_solve_sharded(problem: Problem, mesh: Mesh,
     return _ca_solve_sharded(problem, mesh, spec, interpret,
                              cs, cw, g, rhs, sc2, sc_int, colmask,
                              parallel, _resolve_serial(serial, parallel))
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint/resume on the distributed CA path. Same portable full-grid
+# .npz format and (float32, scaled) fingerprint as every other fp32 path:
+# the CA pending pair (pprev, β) maps to the stored updated direction
+# d = r + β·pprev (resume sets pprev := d − r, β := 1), exactly like the
+# single-device CA driver — so a pod-scale CA solve resumes on the fused
+# sharded, single-device, or XLA paths and vice versa. Halo rings are
+# dropped at save and refreshed by one width-2 exchange at chunk start
+# (value-idempotent for in-memory state: the exchanged values equal the
+# owned values the neighbour would send again).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
+def _ca_chunk_sharded(problem: Problem, mesh: Mesh, spec: CAShardSpec,
+                      interpret: bool, chunk: int, parallel: bool,
+                      serial: bool, cs, cw, g, sc2, colmask,
+                      x_st, r_st, pprev_st, k, done, rr, beta, diff):
+    """Advance the sharded CA solve by ~``chunk`` iterations (a pair
+    straddling the chunk boundary overshoots by one — chunking must not
+    change the iterate sequence, so only the global cap truncates)."""
+    px = mesh.shape[X_AXIS]
+    py = mesh.shape[Y_AXIS]
+
+    def shard_fn(cs_b, cw_b, g_b, sc2_b, colmask_b,
+                 x_b, r_b, p_b, k, done, rr, beta, diff):
+        body = _make_ca_shard_body(problem, spec, px, py, interpret,
+                                   cs_b[0], cw_b[0], g_b[0], sc2_b[0],
+                                   colmask_b, x_b.dtype, parallel, serial)
+        r = _exchange_ring2(r_b[0], spec, px, py)
+        pprev = _exchange_ring2(p_b[0], spec, px, py)
+        s0 = _CAState(k=k, done=done, x=x_b[0], r=r, pprev=pprev,
+                      rr=rr, beta=beta, diff=diff)
+        stop_at = jnp.minimum(k + chunk, problem.iteration_cap)
+
+        def cond(s: _CAState):
+            return (~s.done) & (s.k < stop_at)
+
+        s = lax.while_loop(cond, body, s0)
+        return (s.x[None], s.r[None], s.pprev[None],
+                s.k, s.done, s.rr, s.beta, s.diff)
+
+    stacked = P((X_AXIS, Y_AXIS))
+    rep = P()
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(stacked, stacked, stacked, stacked, rep,
+                  stacked, stacked, stacked, rep, rep, rep, rep, rep),
+        out_specs=(stacked, stacked, stacked, rep, rep, rep, rep, rep),
+        check_vma=False,
+    )(cs, cw, g, sc2, colmask, x_st, r_st, pprev_st, k, done, rr, beta,
+      diff)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _ca_init_stacked(problem: Problem, mesh: Mesh, spec: CAShardSpec,
+                     rhs, colmask):
+    def shard_fn(rhs_b, colmask_b):
+        s = _ca_shard_init(problem, spec, rhs_b[0], colmask_b)
+        return (s.x[None], s.r[None], s.pprev[None],
+                s.k, s.done, s.rr, s.beta, s.diff)
+
+    stacked = P((X_AXIS, Y_AXIS))
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(stacked, P()),
+        out_specs=(stacked, stacked, stacked, P(), P(), P(), P(), P()),
+        check_vma=False,
+    )(rhs, colmask)
+
+
+def ca_cg_solve_sharded_checkpointed(
+        problem: Problem, mesh: Mesh, checkpoint_path: str,
+        chunk: int = 200, bm: int | None = None,
+        interpret: bool | None = None,
+        keep_checkpoint: bool = False,
+        parallel: bool = False,
+        serial: bool | None = None) -> PCGResult:
+    """Distributed CA solve with periodic state persistence and automatic
+    resume (portable cross-backend, cross-mesh, cross-ALGORITHM format —
+    module comment above). fp32 only. All scaffolding is the shared
+    sharded driver (``parallel.pallas_sharded.run_sharded_checkpointed``)
+    with this layout's column offset; only the init/advance legs are
+    CA-specific."""
+    from poisson_tpu.parallel.pallas_sharded import (
+        _CkptState,
+        run_sharded_checkpointed,
+    )
+
+    serial = _resolve_serial(serial, parallel)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    px = mesh.shape[X_AXIS]
+    py = mesh.shape[Y_AXIS]
+    spec = ca_shard_spec(problem, px, py, bm)
+    cs, cw, g, rhs, sc2, _, colmask = _ca_shard_canvases(
+        problem, px, py, spec, "float32"
+    )
+
+    def make_runners(wrapped):
+        cs, cw, g, rhs, sc2, colmask = wrapped
+        init = lambda: _CkptState(
+            *_ca_init_stacked(problem, mesh, spec, rhs, colmask)
+        )
+        advance = lambda s: _CkptState(*_ca_chunk_sharded(
+            problem, mesh, spec, interpret, chunk, parallel, serial,
+            cs, cw, g, sc2, colmask,
+            s.w, s.r, s.p, s.k, s.done, s.zr, s.beta, s.diff,
+        ))
+        return init, advance
+
+    return run_sharded_checkpointed(
+        problem, mesh, checkpoint_path, chunk, keep_checkpoint, spec,
+        _COL0, (cs, cw, g, rhs, sc2, colmask), make_runners,
+    )

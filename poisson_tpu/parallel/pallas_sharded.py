@@ -350,9 +350,13 @@ def pallas_cg_solve_sharded(problem: Problem, mesh: Mesh,
 # ---------------------------------------------------------------------------
 
 
-def _gather_full(problem: Problem, spec: ShardSpec, px: int, py: int,
-                 stacked) -> np.ndarray:
-    """Stacked per-shard canvases → owned interiors on the (M+1, N+1) grid."""
+def _gather_full(problem: Problem, spec, px: int, py: int,
+                 stacked, col0: int = 1) -> np.ndarray:
+    """Stacked per-shard canvases → owned interiors on the (M+1, N+1) grid.
+
+    ``col0`` is the canvas column of a shard's first owned cell (1 on the
+    fused layout's width-1 ring; 2 on the CA layout's width-2 ring —
+    ``parallel.pallas_ca_sharded`` shares these helpers)."""
     M, N = problem.M, problem.N
     stacked = np.asarray(stacked)
     full = np.zeros((M + 1, N + 1), stacked.dtype)
@@ -365,15 +369,16 @@ def _gather_full(problem: Problem, spec: ShardSpec, px: int, py: int,
                 continue
             blk = stacked[ix * py + iy]
             full[gi0 : gi0 + nr, gj0 : gj0 + nc] = blk[
-                HALO : HALO + nr, 1 : 1 + nc
+                HALO : HALO + nr, col0 : col0 + nc
             ]
     return full
 
 
-def _scatter_canvases(problem: Problem, spec: ShardSpec, px: int, py: int,
-                      full) -> np.ndarray:
+def _scatter_canvases(problem: Problem, spec, px: int, py: int,
+                      full, col0: int = 1) -> np.ndarray:
     """(M+1, N+1) grid → stacked per-shard canvases, owned interiors only
-    (halo rings and padding zero; one exchange at chunk start refreshes)."""
+    (halo rings and padding zero; one exchange at chunk start refreshes).
+    ``col0`` as in :func:`_gather_full`."""
     M, N = problem.M, problem.N
     cv = spec.cv
     full = np.asarray(full, np.float32)
@@ -385,7 +390,7 @@ def _scatter_canvases(problem: Problem, spec: ShardSpec, px: int, py: int,
             nc = min(spec.n_blk, N - gj0)
             if nr <= 0 or nc <= 0:
                 continue
-            out[ix * py + iy, HALO : HALO + nr, 1 : 1 + nc] = full[
+            out[ix * py + iy, HALO : HALO + nr, col0 : col0 + nc] = full[
                 gi0 : gi0 + nr, gj0 : gj0 + nc
             ]
     return out
@@ -451,7 +456,11 @@ def _init_stacked(problem: Problem, mesh: Mesh, spec: ShardSpec,
 
 
 class _CkptState(NamedTuple):
-    """Stacked-canvas fused state as seen by the shared checkpoint driver."""
+    """Stacked-canvas solver state in the canonical field order shared by
+    both sharded checkpointed drivers: ``w`` the (scaled) solution
+    canvases, ``r`` the residual, ``p`` the direction material (fused:
+    the pending-β direction; CA: the pending pair's p₁ — both resume as
+    p := d − r, β := 1)."""
 
     w: jnp.ndarray
     r: jnp.ndarray
@@ -463,20 +472,23 @@ class _CkptState(NamedTuple):
     diff: jnp.ndarray
 
 
-def pallas_cg_solve_sharded_checkpointed(
-        problem: Problem, mesh: Mesh, checkpoint_path: str,
-        chunk: int = 200, bm: int | None = None,
-        interpret: bool | None = None,
-        keep_checkpoint: bool = False,
-        parallel: bool = False,
-        serial: bool | None = None) -> PCGResult:
-    """Distributed fused-path solve with periodic state persistence and
-    automatic resume (portable format — see module comment). fp32 only.
+def run_sharded_checkpointed(problem: Problem, mesh: Mesh,
+                             checkpoint_path: str, chunk: int,
+                             keep_checkpoint: bool, spec, col0: int,
+                             canvases, make_runners) -> PCGResult:
+    """Shared scaffolding for the sharded checkpointed drivers (fused and
+    CA — one copy of the multi-process wrapping, portable-state mapping,
+    gather/scatter plumbing, and final unscale).
+
+    ``canvases`` is the process-local ``(cs, cw, g, rhs, sc2, colmask)``
+    tuple; ``make_runners(wrapped_canvases)`` returns ``(init, advance)``
+    where ``init()`` produces the initial :class:`_CkptState` and
+    ``advance(state)`` runs one ~``chunk``-iteration leg. ``col0`` is the
+    canvas column of the first owned cell (driver-layout dependent).
     Multi-process meshes: state is gathered to every process before the
     primary-only write, with barrier-ordered file handoff."""
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
-    serial = _resolve_serial(serial, parallel)
     from poisson_tpu.parallel.checkpoint_sharded import (
         _global_array,
         _multiprocess,
@@ -491,14 +503,9 @@ def pallas_cg_solve_sharded_checkpointed(
     )
     from poisson_tpu.solvers.pcg import PCGState, host_fields64
 
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
     px = mesh.shape[X_AXIS]
     py = mesh.shape[Y_AXIS]
-    spec = shard_spec(problem, px, py, bm)
-    cs, cw, g, rhs, sc2, _, colmask = _shard_canvases(
-        problem, px, py, spec, "float32"
-    )
+    cs, cw, g, rhs, sc2, colmask = canvases
     stacked_sp = P((X_AXIS, Y_AXIS))
     if _multiprocess():
         # Re-wrap the process-local canvases as global arrays (sc_int is
@@ -509,6 +516,7 @@ def pallas_cg_solve_sharded_checkpointed(
         )
         colmask = wrap(colmask, P())
     fp = _fingerprint(problem, "float32", True)
+    init, advance = make_runners((cs, cw, g, rhs, sc2, colmask))
 
     def stacked_state(full_state) -> _CkptState:
         d = np.asarray(full_state.p, np.float32)
@@ -521,10 +529,13 @@ def pallas_cg_solve_sharded_checkpointed(
             _global_array(np.asarray(x, dt), mesh, P())
             if _multiprocess() else jnp.asarray(np.asarray(x, dt))
         )
+        scat = lambda full: _scatter_canvases(
+            problem, spec, px, py, full, col0=col0
+        )
         return _CkptState(
-            w=as_global(_scatter_canvases(problem, spec, px, py, full_state.w)),
-            r=as_global(_scatter_canvases(problem, spec, px, py, r)),
-            p=as_global(_scatter_canvases(problem, spec, px, py, d - r)),
+            w=as_global(scat(full_state.w)),
+            r=as_global(scat(r)),
+            p=as_global(scat(d - r)),
             k=scalar(full_state.k, np.int32),
             done=scalar(full_state.done, bool),
             zr=scalar(full_state.zr, np.float32),
@@ -533,16 +544,13 @@ def pallas_cg_solve_sharded_checkpointed(
         )
 
     saved = load_state(checkpoint_path, fp)
-    if saved is None:
-        state = _CkptState(*_init_stacked(problem, mesh, spec, rhs, colmask))
-    else:
-        state = stacked_state(saved)
+    state = init() if saved is None else stacked_state(saved)
 
     def fetch(x):
         return _replicator(mesh)(x) if _multiprocess() else x
 
     def gather(x):
-        return _gather_full(problem, spec, px, py, fetch(x))
+        return _gather_full(problem, spec, px, py, fetch(x), col0=col0)
 
     def to_portable(s: _CkptState) -> PCGState:
         r_full = gather(s.r)
@@ -555,22 +563,55 @@ def pallas_cg_solve_sharded_checkpointed(
 
     state = run_chunked(
         state,
-        advance=lambda s: _CkptState(*_chunk_solve(
-            problem, mesh, spec, interpret, chunk, parallel, serial,
-            cs, cw, g, sc2, colmask,
-            s.w, s.r, s.p, s.k, s.done, s.zr, s.beta, s.diff,
-        )),
+        advance=advance,
         to_portable=to_portable,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint, primary=is_primary, sync=_sync,
     )
 
     # Solution: gather owned w interiors and unscale with sc on the host
-    # (value-identical to pallas_cg_solve_sharded's per-shard w·sc_int:
-    # same fp32 operands, elementwise).
+    # (value-identical to the one-shot drivers' per-shard w·sc_int: same
+    # fp32 operands, elementwise).
     _, _, _, aux64 = host_fields64(problem, True)
     w_y = gather(state.w)
     w = w_y * np.asarray(aux64, w_y.dtype)
     return PCGResult(w=jnp.asarray(w), iterations=state.k, diff=state.diff,
                      residual_dot=state.zr)
+
+
+def pallas_cg_solve_sharded_checkpointed(
+        problem: Problem, mesh: Mesh, checkpoint_path: str,
+        chunk: int = 200, bm: int | None = None,
+        interpret: bool | None = None,
+        keep_checkpoint: bool = False,
+        parallel: bool = False,
+        serial: bool | None = None) -> PCGResult:
+    """Distributed fused-path solve with periodic state persistence and
+    automatic resume (portable format — see module comment). fp32 only."""
+    serial = _resolve_serial(serial, parallel)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    px = mesh.shape[X_AXIS]
+    py = mesh.shape[Y_AXIS]
+    spec = shard_spec(problem, px, py, bm)
+    cs, cw, g, rhs, sc2, _, colmask = _shard_canvases(
+        problem, px, py, spec, "float32"
+    )
+
+    def make_runners(wrapped):
+        cs, cw, g, rhs, sc2, colmask = wrapped
+        init = lambda: _CkptState(
+            *_init_stacked(problem, mesh, spec, rhs, colmask)
+        )
+        advance = lambda s: _CkptState(*_chunk_solve(
+            problem, mesh, spec, interpret, chunk, parallel, serial,
+            cs, cw, g, sc2, colmask,
+            s.w, s.r, s.p, s.k, s.done, s.zr, s.beta, s.diff,
+        ))
+        return init, advance
+
+    return run_sharded_checkpointed(
+        problem, mesh, checkpoint_path, chunk, keep_checkpoint, spec, 1,
+        (cs, cw, g, rhs, sc2, colmask), make_runners,
+    )
 

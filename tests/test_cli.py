@@ -149,10 +149,11 @@ def test_ca_sharded_backend_cli(capsys):
     assert line["dtype"] == "float32"
 
 
-def test_ca_sharded_checkpoint_rejected():
-    """No checkpointed driver on the sharded CA path: the CLI must say so
-    (and point at the portable cross-algorithm alternative) rather than
-    silently ignore --checkpoint."""
-    with pytest.raises(SystemExit, match="cross-algorithm"):
-        main(["40", "40", "--backend", "pallas-ca-sharded",
-              "--checkpoint", "/tmp/nope.npz"])
+def test_ca_sharded_checkpoint_cli(capsys, tmp_path):
+    """--checkpoint on the sharded CA path: the chunked driver must
+    reproduce the one-shot result (portable cross-algorithm format)."""
+    ck = str(tmp_path / "ck.npz")
+    assert main(["40", "40", "--backend", "pallas-ca-sharded",
+                 "--mesh", "2x2", "--checkpoint", ck, "--chunk", "10",
+                 "--json"]) == 0
+    assert _json_line(capsys)["iterations"] == 50

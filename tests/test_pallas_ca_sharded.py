@@ -108,3 +108,59 @@ def test_parallel_grid_matches_sequential():
     r_par = ca_cg_solve_sharded(p, mesh, parallel=True)
     assert int(r_par.iterations) == int(r_seq.iterations) == 50
     np.testing.assert_array_equal(np.asarray(r_par.w), np.asarray(r_seq.w))
+
+
+def test_checkpointed_chunked_equals_oneshot(tmp_path):
+    from poisson_tpu.parallel.pallas_ca_sharded import (
+        ca_cg_solve_sharded_checkpointed,
+    )
+
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices()[:4])
+    ref = ca_cg_solve_sharded(p, mesh)
+    got = ca_cg_solve_sharded_checkpointed(
+        p, mesh, str(tmp_path / "ck.npz"), chunk=7
+    )
+    assert int(got.iterations) == int(ref.iterations) == 50
+    np.testing.assert_array_equal(np.asarray(got.w), np.asarray(ref.w))
+    assert not (tmp_path / "ck.npz").exists()
+
+
+def test_checkpointed_kill_and_resume_cross_algorithm(tmp_path):
+    """A partial FUSED-sharded checkpoint resumes on the sharded CA path
+    (and the combined solve still converges at the golden count): the
+    pending-pair ↔ updated-direction mapping keeps the portable format
+    cross-ALGORITHM, not just cross-backend."""
+    from poisson_tpu.parallel.pallas_ca_sharded import (
+        ca_cg_solve_sharded_checkpointed,
+    )
+    from poisson_tpu.parallel.pallas_sharded import (
+        pallas_cg_solve_sharded_checkpointed,
+    )
+
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices()[:4])
+    path = str(tmp_path / "ck.npz")
+    partial = pallas_cg_solve_sharded_checkpointed(
+        p.with_(max_iter=20), mesh, path, chunk=10
+    )
+    assert int(partial.iterations) == 20
+    ref = ca_cg_solve_sharded(p, mesh)
+    resumed = ca_cg_solve_sharded_checkpointed(p, mesh, path, chunk=10)
+    assert int(resumed.iterations) == int(ref.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(resumed.w), np.asarray(ref.w), rtol=0, atol=1e-6
+    )
+    # ...and the reverse: a partial CA-sharded checkpoint resumes on the
+    # single-device XLA path.
+    from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
+
+    path2 = str(tmp_path / "ck2.npz")
+    ca_cg_solve_sharded_checkpointed(
+        p.with_(max_iter=15), mesh, path2, chunk=6
+    )
+    got = pcg_solve_checkpointed(p, path2, chunk=20, dtype="float32")
+    assert int(got.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=0, atol=1e-6
+    )
