@@ -263,7 +263,8 @@ def test_serial_kahan_reduce_layout_matches_partials():
     per-strip (nb, 1) SMEM rows to one Kahan-compensated SMEM cell (the
     layout hardware-proven in round 2). Import-frozen, so the variant runs
     in a subprocess; it must reproduce the golden counts and the default
-    layout's L2 on the single-device, column-blocked, and sharded paths."""
+    layout's L2 on the single-device, column-blocked, sharded-fused, and
+    sharded-CA paths."""
     import json
     import os
     import pathlib
@@ -287,9 +288,12 @@ out["blocked"] = [int(r.iterations), l2_error_host(p, r.w)]
 import jax
 from poisson_tpu.parallel import make_solver_mesh
 from poisson_tpu.parallel.pallas_sharded import pallas_cg_solve_sharded
+from poisson_tpu.parallel.pallas_ca_sharded import ca_cg_solve_sharded
 mesh = make_solver_mesh(jax.devices()[:4], grid=(2, 2))
 r = pallas_cg_solve_sharded(Problem(M=40, N=40), mesh)
 out["sharded_2x2"] = [int(r.iterations)]
+r = ca_cg_solve_sharded(Problem(M=40, N=40), mesh)
+out["ca_sharded_2x2"] = [int(r.iterations)]
 print(json.dumps(out))
 """
     env = dict(os.environ)
@@ -311,6 +315,7 @@ print(json.dumps(out))
     assert got["single"][0] == 546
     assert got["blocked"][0] == 546
     assert got["sharded_2x2"][0] == 50
+    assert got["ca_sharded_2x2"][0] == 50
     assert got["single"][1] < 4e-4 and got["blocked"][1] < 4e-4
 
 
