@@ -66,9 +66,9 @@ def _parse_args(argv):
     p.add_argument("--grids", default="40x40,400x600,800x1200")
     p.add_argument("--backends", default="auto",
                    help="comma list of xla,pallas,pallas-ca,sharded,"
-                        "pallas-sharded,native; 'auto' = xla+native, plus "
-                        "sharded when >1 device, plus pallas (and "
-                        "pallas-sharded when >1 device) on TPU")
+                        "pallas-sharded,pallas-ca-sharded,native; 'auto' = "
+                        "xla+native, plus sharded when >1 device, plus "
+                        "pallas (and pallas-sharded when >1 device) on TPU")
     p.add_argument("--meshes", default=None,
                    help="comma list like 1x1,2x2,2x4 (sharded rows; default: "
                         "near-square over all devices)")
@@ -179,8 +179,10 @@ def main(argv=None) -> int:
                                    args.repeat)
                 rows.append(_row("pallas-ca", "1 dev s=2 pairs", problem,
                                  int(res.iterations), best, l2(problem, res.w)))
-            elif backend in ("sharded", "pallas-sharded"):
+            elif backend in ("sharded", "pallas-sharded",
+                             "pallas-ca-sharded"):
                 from poisson_tpu.parallel import (
+                    ca_cg_solve_sharded,
                     make_solver_mesh,
                     pallas_cg_solve_sharded,
                     pcg_solve_sharded,
@@ -199,6 +201,8 @@ def main(argv=None) -> int:
                     px, py = mesh.shape["x"], mesh.shape["y"]
                     if backend == "pallas-sharded":
                         run = lambda: pallas_cg_solve_sharded(problem, mesh)
+                    elif backend == "pallas-ca-sharded":
+                        run = lambda: ca_cg_solve_sharded(problem, mesh)
                     else:
                         run = lambda: pcg_solve_sharded(problem, mesh)
                     res, best = _timed(run, fence, args.repeat)
