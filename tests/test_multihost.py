@@ -86,6 +86,23 @@ assert os.path.exists(ck2)
 resumed = pallas_cg_solve_sharded_checkpointed(p40, mesh, ck2, chunk=10)
 assert int(resumed.iterations) == 50, int(resumed.iterations)
 assert float(resumed.diff) < 1e-6
+
+# CA (s=2) sharded across the process boundary: the width-2 ring
+# ppermutes and the per-pair 12-entry Gram psum traverse the
+# inter-process transport. The checkpointed driver is the multi-process
+# entry point (it re-wraps the host canvases as global arrays; the
+# one-shot driver, like the fused one-shot, is single-process).
+from poisson_tpu.parallel.pallas_ca_sharded import (
+    ca_cg_solve_sharded_checkpointed,
+)
+
+ck3 = ck + ".ca"
+partial = ca_cg_solve_sharded_checkpointed(
+    p40.with_(max_iter=20), mesh, ck3, chunk=10
+)
+assert int(partial.iterations) == 20, int(partial.iterations)
+resumed = ca_cg_solve_sharded_checkpointed(p40, mesh, ck3, chunk=10)
+assert int(resumed.iterations) == 50, int(resumed.iterations)
 print(f"RANK{rank}_OK", flush=True)
 """
 
