@@ -138,6 +138,67 @@ def _solver_iter_seconds(problem, bm: int | None, iters: int,
     return per_iter, geom
 
 
+def _ca_iter_seconds(problem, bm: int | None, iters: int,
+                     interpret: bool,
+                     parallel: bool = False) -> tuple[float, dict]:
+    """Per-iteration slope of the CA(s=2) pair path (full-width only).
+
+    Pass model per PAIR of iterations: kernel C reads pprev, r, cs, cw, g
+    as halo-inclusive strips plus the sc2 block and writes pn, t1, t2, t3
+    (5·row_of + 1 + 4); kernel D reads six center blocks and writes three
+    (9). Per iteration: (5·row_of + 14)/2 ≈ 10.1 at the plateau
+    geometry — the 1.46× traffic reduction BENCH.md's CA section claims,
+    now measurable against the same stream ceiling as the fused rows."""
+    import dataclasses
+
+    from poisson_tpu.ops.pallas_ca import _ca_solve, pick_bm_ca
+    from poisson_tpu.ops.pallas_cg import (
+        HALO,
+        _resolve_serial,
+        build_canvases,
+    )
+
+    if iters < 20:
+        raise ValueError(f"need --iters >= 20 for a meaningful slope, got {iters}")
+    lo = dataclasses.replace(problem, delta=1e-30, max_iter=iters // 4)
+    hi = dataclasses.replace(problem, delta=1e-30, max_iter=iters)
+    serial = _resolve_serial(None, parallel)
+    if bm is None:
+        bm = pick_bm_ca(problem)
+    cv, cs, cw, g, rhs, sc2, _ = build_canvases(hi, bm, "float32", 0)
+
+    def run(p):
+        s = _ca_solve(p, cv, interpret, parallel, serial,
+                      cs, cw, g, rhs, sc2)
+        s.diff.block_until_ready()
+        return s
+
+    run(lo)
+    run(hi)
+
+    def timed(p) -> float:
+        t0 = time.perf_counter()
+        run(p)
+        return time.perf_counter() - t0
+
+    t_lo = min(timed(lo) for _ in range(3))
+    t_hi = min(timed(hi) for _ in range(3))
+    per_iter = (t_hi - t_lo) / (hi.max_iter - lo.max_iter)
+
+    canvas_bytes = cv.rows * cv.cols * 4
+    row_of = (cv.bm + 2 * HALO) / cv.bm
+    passes = (5 * row_of + 1 + 4 + 9) / 2.0   # per iteration (pair / 2)
+    geom = {
+        "backend": "ca", "bm": cv.bm, "nb": cv.nb, "bn": None, "ncb": 1,
+        "serial_reduce": serial,
+        "canvas_rows": cv.rows,
+        "canvas_cols": cv.cols, "canvas_mb": round(canvas_bytes / 2**20, 1),
+        "model_passes": round(passes, 2),
+        "model_bytes_per_iter_mb": round(passes * canvas_bytes / 2**20, 1),
+    }
+    return per_iter, geom
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("M", nargs="?", type=int, default=2400)
@@ -152,6 +213,10 @@ def main() -> int:
                     help="comma-separated column-block widths to add to the "
                          "sweep (each paired with every --bm; 0 = full "
                          "width)")
+    ap.add_argument("--backend", default="fused",
+                    help="comma list of fused,ca — the 2-sweep path and/or "
+                         "the CA(s=2) pair path (CA ignores --bn: "
+                         "full-width only)")
     args = ap.parse_args()
 
     honor_jax_platforms_env()
@@ -185,32 +250,50 @@ def main() -> int:
     # bn=0 is canvas_spec's force-full-width sentinel; None (no flag) is
     # the shipping auto-pick.
     bns = ([int(b) for b in args.bn.split(",")] if args.bn else [None])
+    backends = args.backend.split(",")
+    unknown = set(backends) - {"fused", "ca"}
+    if unknown:
+        print(f"unknown --backend {sorted(unknown)}", file=sys.stderr)
+        return 2
     rows = []
-    for bm in bms:
-        for bn in bns:
-            for parallel in ([False, True] if args.parallel else [False]):
-                try:
-                    per_iter, geom = _solver_iter_seconds(
-                        problem, bm, args.iters, interpret, parallel, bn
+    for backend in backends:
+        for bm in bms:
+            for bn in (bns if backend == "fused" else [None]):
+                for parallel in ([False, True] if args.parallel
+                                 else [False]):
+                    try:
+                        if backend == "ca":
+                            per_iter, geom = _ca_iter_seconds(
+                                problem, bm, args.iters, interpret, parallel
+                            )
+                        else:
+                            per_iter, geom = _solver_iter_seconds(
+                                problem, bm, args.iters, interpret,
+                                parallel, bn
+                            )
+                    except Exception as e:
+                        rows.append({"backend": backend, "bm": bm, "bn": bn,
+                                     "parallel": parallel,
+                                     "error": repr(e)[:200]})
+                        continue
+                    implied = (
+                        geom["model_bytes_per_iter_mb"] * 2**20
+                        / per_iter / 1e9
                     )
-                except Exception as e:
-                    rows.append({"bm": bm, "bn": bn, "parallel": parallel,
-                                 "error": repr(e)[:200]})
-                    continue
-                implied = (
-                    geom["model_bytes_per_iter_mb"] * 2**20 / per_iter / 1e9
-                )
-                mlups = (problem.M - 1) * (problem.N - 1) / per_iter / 1e6
-                rows.append({
-                    **geom,
-                    "parallel": parallel,
-                    "iter_seconds": round(per_iter, 6),
-                    "mlups": round(mlups, 1),
-                    "implied_gbps": round(implied, 1),
-                    "implied_over_stream": round(
-                        implied / report["stream_gbps"], 2
-                    ) if report["stream_gbps"] else None,
-                })
+                    mlups = (
+                        (problem.M - 1) * (problem.N - 1) / per_iter / 1e6
+                    )
+                    rows.append({
+                        "backend": backend,
+                        **geom,
+                        "parallel": parallel,
+                        "iter_seconds": round(per_iter, 6),
+                        "mlups": round(mlups, 1),
+                        "implied_gbps": round(implied, 1),
+                        "implied_over_stream": round(
+                            implied / report["stream_gbps"], 2
+                        ) if report["stream_gbps"] else None,
+                    })
     report["grid"] = [args.M, args.N]
     report["solver"] = rows
     print(json.dumps(report))

@@ -807,6 +807,13 @@ def main() -> int:
         py, "benchmarks/roofline.py", "2400", "3200",
         "--bn", "1024,2048", "--iters", "200", "--parallel",
     ], timeout=1800, parse_json_tail=True)
+    # CA pass-model A/B at the plateau: the same stream ceiling, the CA
+    # ~10.1-pass model vs the fused ~14.7 — settles whether the measured
+    # CA advantage (ca_probe) matches its traffic model.
+    s.run("roofline_2400x3200_ca", [
+        py, "benchmarks/roofline.py", "2400", "3200",
+        "--backend", "ca", "--bm", "48,72", "--iters", "200",
+    ], timeout=1800, parse_json_tail=True)
     if not args.quick:
         s.run("roofline_1600x2400", [
             py, "benchmarks/roofline.py", "1600", "2400",

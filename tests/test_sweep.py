@@ -17,7 +17,8 @@ def test_roofline_smoke(capsys):
     import roofline
 
     old_argv = sys.argv
-    sys.argv = ["roofline.py", "40", "40", "--iters", "40"]
+    sys.argv = ["roofline.py", "40", "40", "--iters", "40",
+                "--backend", "fused,ca"]
     try:
         assert roofline.main() == 0
     finally:
@@ -26,6 +27,12 @@ def test_roofline_smoke(capsys):
     rec = json.loads(out)
     assert rec["platform"] == "cpu"
     assert rec["solver"] and "mlups" in rec["solver"][0]
+    by_backend = {row["backend"]: row for row in rec["solver"]}
+    assert set(by_backend) == {"fused", "ca"}
+    # The CA pass model must undercut the fused one at the same geometry
+    # (the whole point of the s=2 restructuring).
+    assert (by_backend["ca"]["model_passes"]
+            < by_backend["fused"]["model_passes"])
 
 
 def test_sweep_tiny_grid(tmp_path, capsys):
