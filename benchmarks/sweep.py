@@ -65,10 +65,11 @@ def _parse_args(argv):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--grids", default="40x40,400x600,800x1200")
     p.add_argument("--backends", default="auto",
-                   help="comma list of xla,pallas,pallas-ca,sharded,"
-                        "pallas-sharded,pallas-ca-sharded,native; 'auto' = "
-                        "xla+native, plus sharded when >1 device, plus "
-                        "pallas (and pallas-sharded when >1 device) on TPU")
+                   help="comma list of xla,pallas,pallas-ca,pallas-resident,"
+                        "sharded,pallas-sharded,pallas-ca-sharded,native; "
+                        "'auto' = xla+native, plus sharded when >1 device, "
+                        "plus pallas (and pallas-sharded when >1 device) on "
+                        "TPU (pallas-resident skips grids that exceed VMEM)")
     p.add_argument("--meshes", default=None,
                    help="comma list like 1x1,2x2,2x4 (sharded rows; default: "
                         "near-square over all devices)")
@@ -179,6 +180,21 @@ def main(argv=None) -> int:
                                    args.repeat)
                 rows.append(_row("pallas-ca", "1 dev s=2 pairs", problem,
                                  int(res.iterations), best, l2(problem, res.w)))
+            elif backend == "pallas-resident":
+                from poisson_tpu.ops.pallas_resident import (
+                    fits_resident,
+                    resident_cg_solve,
+                )
+
+                if not fits_resident(problem):
+                    print(f"  skip: pallas-resident does not fit {grid}",
+                          file=sys.stderr)
+                    continue
+                res, best = _timed(lambda: resident_cg_solve(problem),
+                                   fence, args.repeat)
+                rows.append(_row("pallas-resident", "1 dev VMEM-resident",
+                                 problem, int(res.iterations), best,
+                                 l2(problem, res.w)))
             elif backend in ("sharded", "pallas-sharded",
                              "pallas-ca-sharded"):
                 from poisson_tpu.parallel import (

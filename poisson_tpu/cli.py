@@ -57,14 +57,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-iter", type=int, default=None,
                    help="iteration cap (default (M-1)(N-1))")
     p.add_argument("--backend",
-                   choices=("auto", "xla", "pallas", "pallas-ca", "sharded",
-                            "pallas-sharded", "pallas-ca-sharded", "native"),
+                   choices=("auto", "xla", "pallas", "pallas-ca",
+                            "pallas-resident", "sharded", "pallas-sharded",
+                            "pallas-ca-sharded", "native"),
                    default="auto",
                    help="auto: pallas-sharded on >1 TPU, sharded on >1 CPU "
                         "device, pallas on 1 TPU, else xla. pallas-ca[-"
                         "sharded]: the communication-avoiding s=2 pair "
                         "iteration (fp32, full-width; opt-in), single-device "
-                        "or over the mesh with width-2 halos")
+                        "or over the mesh with width-2 halos. "
+                        "pallas-resident: the whole solve in one "
+                        "VMEM-resident kernel (grids that fit, ~<=400x600)")
     p.add_argument("--mesh", type=_parse_mesh, default=None, metavar="PXxPY",
                    help="device mesh shape for --backend sharded (default: "
                         "near-square over all devices)")
@@ -265,6 +268,32 @@ def _run_jax(args, problem: Problem, backend: str):
                 problem, mesh, dtype=args.dtype, setup=args.setup
             )
         n_dev = mesh_shape[0] * mesh_shape[1]
+    elif backend == "pallas-resident":
+        if args.dtype == "float64":
+            raise SystemExit(
+                "--backend pallas-resident is the fp32 fused path; use "
+                "--backend xla for float64"
+            )
+        if args.checkpoint:
+            raise SystemExit(
+                "--backend pallas-resident runs the whole solve in one "
+                "kernel launch; there is no chunk boundary to checkpoint "
+                "at — use --backend pallas (the portable format resumes "
+                "across backends)"
+            )
+        from poisson_tpu.ops.pallas_resident import (
+            fits_resident,
+            resident_cg_solve,
+        )
+
+        if not fits_resident(problem):
+            raise SystemExit(
+                f"--backend pallas-resident: grid {problem.M}x{problem.N} "
+                "exceeds the VMEM residency budget (~<=400x600); use "
+                "--backend pallas or pallas-ca"
+            )
+        run = lambda: resident_cg_solve(problem)
+        n_dev = 1
     elif backend == "pallas-ca":
         if args.dtype == "float64":
             raise SystemExit(
@@ -341,8 +370,8 @@ def _run_jax(args, problem: Problem, backend: str):
 
     dtype_name = (
         "float32"
-        if backend in ("pallas", "pallas-ca", "pallas-sharded",
-                       "pallas-ca-sharded")
+        if backend in ("pallas", "pallas-ca", "pallas-resident",
+                       "pallas-sharded", "pallas-ca-sharded")
         else resolve_dtype(args.dtype)
     )
     report = solve_report(

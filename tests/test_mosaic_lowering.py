@@ -169,6 +169,27 @@ def test_ca_sharded_masked_lowers(grid, serial):
     )
 
 
+@pytest.mark.parametrize("grid", [(40, 40), (400, 600)],
+                         ids=["40x40", "400x600"])
+def test_resident_persistent_kernel_lowers(grid):
+    # The whole-solve in-kernel while_loop with VMEM scratch state — the
+    # persistent-kernel path at both grids it serves (400x600 is the
+    # capacity target and the largest whole-array reduce).
+    from poisson_tpu.ops import pallas_resident
+
+    p = Problem(M=grid[0], N=grid[1])
+    cv = pallas_resident.resident_canvas(p)
+    _, cs, cw, g, rhs, sc2, _ = pallas_cg.build_canvases(
+        p, cv.bm, "float32", 0
+    )
+    _export_tpu(
+        lambda cs, cw, g, rhs, sc2: pallas_resident._resident_solve(
+            p, cv, False, cs, cw, g, rhs, sc2
+        ),
+        cs, cw, g, rhs, sc2,
+    )
+
+
 @pytest.mark.slow
 def test_flagship_geometry_lowers_both_layouts():
     """The shipping flagship configuration (800×1200, auto bm) — the

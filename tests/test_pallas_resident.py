@@ -1,0 +1,60 @@
+"""VMEM-resident persistent-kernel solver (interpret mode on CPU).
+
+The claim under test: one kernel launch, whole PCG loop in-kernel, and
+the arithmetic is the fused path's — so golden iteration counts are
+exact and solutions match the streaming fused solver to fp32 noise.
+"""
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.ops.pallas_cg import pallas_cg_solve
+from poisson_tpu.ops.pallas_resident import (
+    fits_resident,
+    resident_cg_solve,
+)
+from poisson_tpu.solvers.pcg import pcg_solve
+
+
+def test_golden_40x40_matches_fused():
+    p = Problem(M=40, N=40)
+    r = resident_cg_solve(p)
+    ref = pallas_cg_solve(p)
+    assert int(r.iterations) == int(ref.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(r.w), np.asarray(ref.w), rtol=0, atol=1e-6
+    )
+
+
+def test_golden_400x600():
+    """The largest small-tier published grid — the capacity target."""
+    p = Problem(M=400, N=600)
+    r = resident_cg_solve(p)
+    assert int(r.iterations) == 546
+    assert float(r.diff) < 1e-6
+    ref = pcg_solve(p)  # fp64 oracle
+    np.testing.assert_allclose(
+        np.asarray(r.w, np.float64), np.asarray(ref.w), atol=2e-5
+    )
+
+
+def test_vmem_gate():
+    assert fits_resident(Problem(M=400, N=600))
+    assert not fits_resident(Problem(M=800, N=1200))
+    with pytest.raises(ValueError, match="VMEM"):
+        resident_cg_solve(Problem(M=800, N=1200))
+
+
+def test_rhs_gate_is_bit_exact():
+    p = Problem(M=40, N=40)
+    r1 = resident_cg_solve(p)
+    r2 = resident_cg_solve(p, rhs_gate=np.float32(1.0))
+    assert int(r1.iterations) == int(r2.iterations)
+    assert np.array_equal(np.asarray(r1.w), np.asarray(r2.w))
+
+
+def test_iteration_cap_truncates():
+    p = Problem(M=40, N=40, delta=1e-30, max_iter=12)
+    r = resident_cg_solve(p)
+    assert int(r.iterations) == 12
