@@ -235,6 +235,31 @@ class TestMeasuredChainAdoption:
         assert bench_mod._read_good(tmp_path / "missing.json") == {}
 
 
+class TestProbeSnippets:
+    """The session's embedded probe programs only ever execute on a
+    scarce healthy-tunnel window; a typo or a renamed import must be
+    caught here, not there."""
+
+    _NAMES = ("_KERNEL_PROBE", "_CA_PROBE", "_SHARDED_1X1",
+              "_CA_SHARDED_1X1", "_RESIDENT_PROBE", "_BIG_GRID")
+
+    @pytest.mark.parametrize("name", _NAMES)
+    def test_parses_and_imports_resolve(self, name):
+        import ast
+        import importlib
+
+        src = getattr(tpu_session, name)
+        tree = ast.parse(src)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.startswith("poisson_tpu"):
+                mod = importlib.import_module(node.module)
+                for alias in node.names:
+                    assert hasattr(mod, alias.name), (
+                        f"{name}: {node.module}.{alias.name} missing"
+                    )
+
+
 class TestSessionResume:
     def _mklog(self, tmp_path, entries):
         log = tmp_path / "session.jsonl"
