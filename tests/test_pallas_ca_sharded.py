@@ -110,6 +110,32 @@ def test_parallel_grid_matches_sequential():
     np.testing.assert_array_equal(np.asarray(r_par.w), np.asarray(r_seq.w))
 
 
+def test_explicit_thin_strips():
+    """bm=8 forces multi-strip shards (nb > 1): the whole-window Gram
+    output and the ±2 band gating must hold across strip seams inside a
+    shard, not only at shard boundaries."""
+    from poisson_tpu.parallel.pallas_ca_sharded import ca_shard_spec
+
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices()[:4], grid=(2, 2))
+    assert ca_shard_spec(p, 2, 2, bm=8).cv.nb > 1
+    ref = ca_cg_solve_sharded(p, mesh)
+    got = ca_cg_solve_sharded(p, mesh, bm=8)
+    assert int(got.iterations) == int(ref.iterations) == 50
+    np.testing.assert_allclose(
+        np.asarray(got.w), np.asarray(ref.w), rtol=0, atol=5e-6
+    )
+
+
+def test_rhs_gate_is_bit_exact():
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh(jax.devices()[:4])
+    r1 = ca_cg_solve_sharded(p, mesh)
+    r2 = ca_cg_solve_sharded(p, mesh, rhs_gate=np.float32(1.0))
+    assert int(r1.iterations) == int(r2.iterations)
+    assert np.array_equal(np.asarray(r1.w), np.asarray(r2.w))
+
+
 def test_checkpointed_chunked_equals_oneshot(tmp_path):
     from poisson_tpu.parallel.pallas_ca_sharded import (
         ca_cg_solve_sharded_checkpointed,

@@ -58,3 +58,27 @@ def test_iteration_cap_truncates():
     p = Problem(M=40, N=40, delta=1e-30, max_iter=12)
     r = resident_cg_solve(p)
     assert int(r.iterations) == 12
+
+
+def test_unweighted_norm_matches_fused():
+    """stage0's unweighted convergence norm flows through the in-kernel
+    norm_w constant exactly like the streaming kernels'."""
+    p = Problem(M=40, N=40, weighted_norm=False)
+    r = resident_cg_solve(p)
+    ref = pallas_cg_solve(p)
+    assert int(r.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(r.w), np.asarray(ref.w), rtol=0, atol=1e-6
+    )
+
+
+def test_wide_grid_with_lane_padding():
+    """M ≠ N with real lane padding (301 content cols → 384): padded
+    columns must stay inert in the whole-array in-kernel reductions."""
+    p = Problem(M=40, N=300)
+    r = resident_cg_solve(p)
+    ref = pallas_cg_solve(p)
+    assert int(r.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(r.w), np.asarray(ref.w), rtol=0, atol=1e-6
+    )
