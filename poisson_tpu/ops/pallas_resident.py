@@ -169,6 +169,47 @@ def _resident_solve(problem: Problem, cv: Canvas, interpret: bool,
     )(cs, cw, g, rhs, sc2)
 
 
+def resident_cg_solve_rhs(problem: Problem, rhs_grid64,
+                          interpret: bool | None = None):
+    """Resident solve of ``A w = rhs`` for a caller-supplied RHS grid
+    (fp64 host array, full (M+1, N+1) shape) — the mixed-precision
+    refinement hook (``solvers.refine``), mirroring
+    ``ops.pallas_cg.pallas_cg_solve_rhs`` on the persistent-kernel path
+    so each inner correction solve is a single launch.
+
+    Returns ``(w64, iterations)`` with w accumulated on the host in fp64.
+    """
+    import numpy as np
+
+    from poisson_tpu.ops.pallas_cg import scaled_stencil_fields
+
+    if not fits_resident(problem):
+        raise ValueError(
+            f"grid {problem.M}x{problem.N} exceeds the VMEM residency "
+            "budget; use pallas_cg_solve_rhs"
+        )
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    cv = resident_canvas(problem)
+    _, cs, cw, g, _, sc2, sc_int = build_canvases(
+        problem, cv.bm, "float32", 0
+    )
+    _, _, _, _, sc64 = scaled_stencil_fields(problem)
+    M, N = problem.M, problem.N
+    scaled = np.asarray(rhs_grid64, np.float64) * sc64
+    rhs_canvas = np.zeros((cv.rows, cv.cols), np.float64)
+    rhs_canvas[HALO : HALO + M - 1, : N + 1] = scaled[1:M, :]
+    rhs = jnp.asarray(rhs_canvas, jnp.float32)
+    w, k, diff, zr = _resident_solve(problem, cv, interpret,
+                                     cs, cw, g, rhs, sc2)
+    y = w[HALO : HALO + M - 1, 1:N]
+    w64 = np.zeros(problem.grid_shape, np.float64)
+    w64[1:M, 1:N] = np.asarray(y, np.float64) * np.asarray(
+        sc_int, np.float64
+    )
+    return w64, int(k[0, 0])
+
+
 def resident_cg_solve(problem: Problem, interpret: bool | None = None,
                       rhs_gate=None) -> PCGResult:
     """Single-device solve with the whole PCG loop resident in VMEM.

@@ -81,16 +81,35 @@ def refined_solve(problem: Problem, tol: float = 1e-10,
                   max_refinements: int = 8,
                   bm: int | None = None, bn: int | None = None,
                   interpret: bool | None = None,
-                  parallel: bool = False) -> RefineResult:
+                  parallel: bool = False,
+                  backend: str = "fused") -> RefineResult:
     """Solve A w = B to relative *scaled-system* residual ``tol``
     (module doc: the raw residual is 1/ε-stiffness-dominated and
     meaningless here) using fp32 device solves plus fp64 host residuals.
 
     Stops when ‖D^{-1/2}(b − A·w)‖ / ‖D^{-1/2}b‖ ≤ tol or after
     ``max_refinements`` correction passes. Geometry/scheduling knobs are
-    forwarded to the fused inner solver.
+    forwarded to the fused inner solver. ``backend="resident"`` runs each
+    inner correction solve as one VMEM-resident kernel launch
+    (``ops.pallas_resident``; grids that fit only — the geometry knobs
+    do not apply there).
     """
-    from poisson_tpu.ops.pallas_cg import pallas_cg_solve_rhs
+    if backend == "resident":
+        if bm is not None or bn is not None or parallel:
+            raise ValueError(
+                "bm/bn/parallel shape the fused streaming kernels; the "
+                "resident backend has a fixed single-strip geometry"
+            )
+        from poisson_tpu.ops.pallas_resident import resident_cg_solve_rhs
+
+        def _inner(problem, rhs, **_kw):
+            return resident_cg_solve_rhs(problem, rhs, interpret=interpret)
+
+        pallas_cg_solve_rhs = _inner
+    elif backend == "fused":
+        from poisson_tpu.ops.pallas_cg import pallas_cg_solve_rhs
+    else:
+        raise ValueError(f"unknown refine backend {backend!r}")
 
     a64, b64, rhs64, sc64 = _fields(problem)
     bt_norm = _weighted_norm(problem, sc64 * rhs64)   # ‖b̃‖

@@ -63,6 +63,22 @@ def test_refinement_reaches_fp64_floor(M, N):
     assert res.inner_iterations[0] == golden
 
 
+def test_resident_backend_reaches_floor():
+    """Refinement over the VMEM-resident inner solver: each correction
+    pass is one kernel launch, and the fp64 floor is reached exactly as
+    with the fused inner solver."""
+    from poisson_tpu.solvers.refine import refined_solve
+
+    p = Problem(M=40, N=40)
+    fused = refined_solve(p, tol=1e-10)
+    res = refined_solve(p, tol=1e-10, backend="resident")
+    assert res.converged
+    assert res.relative_residual <= 1e-10
+    assert res.refinements <= fused.refinements + 1
+    with pytest.raises(ValueError, match="resident"):
+        refined_solve(p, backend="resident", bm=16)
+
+
 def test_refined_matches_tight_fp64_solve():
     """The refined solution agrees with a tightened fp64 XLA solve to
     ~1e-8 — fp64 answers from fp32 device sweeps."""
