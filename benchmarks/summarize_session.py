@@ -36,6 +36,32 @@ def _first(*vals):
     return None
 
 
+# v5e stream ceiling (BENCH.md's physical-consistency model) and each
+# backend's canvas-pass model: a measurement whose per-iteration time
+# admits FEWER effective array passes than its backend's model moves is
+# an overlap/measurement artifact, not throughput (the round-2 failure
+# class). xla's effective pass count is fusion-dependent — ~8 is the
+# break-even documented in BENCH.md's headline sanity paragraph.
+_STREAM_TBPS = 0.82
+_MODEL_PASSES = {"pallas_fused": 14.7, "pallas_ca": 10.1, "xla": 8.0}
+
+
+def _passes_budget(det: dict) -> tuple[str, str]:
+    """(passes-at-ceiling, verdict) for a bench detail record."""
+    grid = det.get("grid")
+    secs = det.get("solve_seconds")
+    iters = det.get("iterations")
+    if not (isinstance(grid, list) and len(grid) == 2 and secs and iters):
+        return "—", ""
+    array_bytes = (grid[0] + 1) * (grid[1] + 1) * 4
+    budget = _STREAM_TBPS * 1e12 * (secs / iters) / array_bytes
+    model = _MODEL_PASSES.get(det.get("backend"))
+    verdict = ""
+    if model is not None and det.get("platform") == "tpu":
+        verdict = " SUSPECT(overlap?)" if budget < model else " sane"
+    return f"{budget:.1f}", verdict
+
+
 def _row_from(step: str, e: dict) -> list[str] | None:
     at = e.get("at", "—")
     r = e.get("result")
@@ -51,7 +77,7 @@ def _row_from(step: str, e: dict) -> list[str] | None:
             status = json.dumps(
                 {k: v for k, v in e.items() if k not in ("step", "at")}
             )
-        return [step, status[:60], "—", "—", "—", at]
+        return [step, status[:60], "—", "—", "—", "—", at]
     det = r.get("detail") or {}
     backend = _first(det.get("backend"), r.get("backend"), "—")
     platform = _first(det.get("platform"), r.get("platform"),
@@ -63,8 +89,9 @@ def _row_from(step: str, e: dict) -> list[str] | None:
     l2 = _first(det.get("l2_error_vs_analytic"), r.get("l2"),
                 r.get("l2_error"))
     status = "ok" if r.get("ok", e.get("ok")) else "FAILED"
+    budget, verdict = _passes_budget(det)
     return [step, f"{backend} ({platform}) {status}", _fmt(mlups),
-            _fmt(iters), _fmt(l2), at]
+            _fmt(iters), _fmt(l2), budget + verdict, at]
 
 
 def main() -> int:
@@ -93,10 +120,13 @@ def main() -> int:
         row = _row_from(step, e)
         if row:
             rows.append(row)
-    print("| step | backend/status | MLUPS | iters | L2 | at |")
-    print("|---|---|---|---|---|---|")
+    print("| step | backend/status | MLUPS | iters | L2 | passes@0.82TB/s | at |")
+    print("|---|---|---|---|---|---|---|")
     for row in rows:
         print("| " + " | ".join(row) + " |")
+    print("\npasses@0.82TB/s = effective array passes/iteration the "
+          "measurement admits at the v5e stream ceiling; below the "
+          "backend's pass model ⇒ overlap artifact (BENCH.md rule 2).")
     for at, step, e in decisions:
         body = {k: v for k, v in e.items() if k not in ("step", "at")}
         print(f"\n**{step}** ({at}): {json.dumps(body)[:400]}")

@@ -235,6 +235,45 @@ class TestMeasuredChainAdoption:
         assert bench_mod._read_good(tmp_path / "missing.json") == {}
 
 
+class TestSummarizerBandwidthCheck:
+    """The summarizer's passes-at-ceiling column is the working form of
+    BENCH.md's physical-consistency rule; pin it against a real sane
+    record and a round-2-style overlap artifact."""
+
+    def _mod(self):
+        spec = importlib.util.spec_from_file_location(
+            "summarize_session",
+            _ROOT + "/benchmarks/summarize_session.py",
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_sane_and_suspect_verdicts(self):
+        m = self._mod()
+        sane = {"grid": [800, 1200], "solve_seconds": 0.0397,
+                "iterations": 989, "backend": "xla", "platform": "tpu"}
+        budget, verdict = m._passes_budget(sane)
+        assert float(budget) == pytest.approx(8.6, abs=0.1)
+        assert verdict == " sane"
+        # The withdrawn round-2 flagship row: 0.0211 s / 989 iters on the
+        # fused kernels — admits ~4.5 passes where the kernels move 14.7.
+        r2 = {"grid": [800, 1200], "solve_seconds": 0.0211,
+              "iterations": 989, "backend": "pallas_fused",
+              "platform": "tpu"}
+        budget, verdict = m._passes_budget(r2)
+        assert float(budget) < 5.0
+        assert "SUSPECT" in verdict
+
+    def test_incomplete_records_stay_quiet(self):
+        m = self._mod()
+        assert m._passes_budget({}) == ("—", "")
+        cpu = {"grid": [40, 40], "solve_seconds": 0.1, "iterations": 50,
+               "backend": "xla", "platform": "cpu"}
+        _, verdict = m._passes_budget(cpu)
+        assert verdict == ""
+
+
 class TestProbeSnippets:
     """The session's embedded probe programs only ever execute on a
     scarce healthy-tunnel window; a typo or a renamed import must be
