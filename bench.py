@@ -431,6 +431,9 @@ def main() -> int:
             "backend": backend,
             "devices": len(devices),
             "platform": platform,
+            # The summarizer's passes-at-ceiling verdict is calibrated to
+            # the v5e stream ceiling; it gates on this field.
+            "device_kind": getattr(devices[0], "device_kind", None),
             # Kernel reduction-partial layout (ops.pallas_cg): the two
             # layouts are numerically equivalent but compile differently,
             # so the artifact must say which one set a record.

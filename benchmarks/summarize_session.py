@@ -42,12 +42,26 @@ def _first(*vals):
 # an overlap/measurement artifact, not throughput (the round-2 failure
 # class). xla's effective pass count is fusion-dependent — ~8 is the
 # break-even documented in BENCH.md's headline sanity paragraph.
+# The ceiling is a *v5e* number: records from any other TPU generation get
+# the passes figure printed with no sane/SUSPECT verdict (a v5p session
+# judged against the v5e ceiling would mislabel every row).
 _STREAM_TBPS = 0.82
 _MODEL_PASSES = {"pallas_fused": 14.7, "pallas_ca": 10.1, "xla": 8.0}
 
 
-def _passes_budget(det: dict) -> tuple[str, str]:
-    """(passes-at-ceiling, verdict) for a bench detail record."""
+def _is_v5e(device_kind) -> bool:
+    """True for the device_kind strings libtpu uses for v5e parts
+    ('TPU v5e', 'TPU v5 lite', 'TPU v5litepod…')."""
+    if not device_kind:
+        return False
+    kind = str(device_kind).lower()
+    return "v5e" in kind or ("v5" in kind and "lite" in kind)
+
+
+def _passes_budget(det: dict, device_kind=None) -> tuple[str, str]:
+    """(passes-at-ceiling, verdict) for a bench detail record.
+    ``device_kind`` falls back to the record's own field; the verdict is
+    only emitted for v5e records — the ceiling was measured there."""
     grid = det.get("grid")
     secs = det.get("solve_seconds")
     iters = det.get("iterations")
@@ -57,7 +71,8 @@ def _passes_budget(det: dict) -> tuple[str, str]:
     budget = _STREAM_TBPS * 1e12 * (secs / iters) / array_bytes
     model = _MODEL_PASSES.get(det.get("backend"))
     verdict = ""
-    if model is not None and det.get("platform") == "tpu":
+    if (model is not None and det.get("platform") == "tpu"
+            and _is_v5e(device_kind or det.get("device_kind"))):
         verdict = " SUSPECT(overlap?)" if budget < model else " sane"
     return f"{budget:.1f}", verdict
 
@@ -89,7 +104,9 @@ def _row_from(step: str, e: dict) -> list[str] | None:
     l2 = _first(det.get("l2_error_vs_analytic"), r.get("l2"),
                 r.get("l2_error"))
     status = "ok" if r.get("ok", e.get("ok")) else "FAILED"
-    budget, verdict = _passes_budget(det)
+    kind = _first(det.get("device_kind"), r.get("device_kind"),
+                  r.get("kind"))
+    budget, verdict = _passes_budget(det, kind)
     return [step, f"{backend} ({platform}) {status}", _fmt(mlups),
             _fmt(iters), _fmt(l2), budget + verdict, at]
 

@@ -108,6 +108,52 @@ def build_parser() -> argparse.ArgumentParser:
                         "mesh shapes)")
     p.add_argument("--chunk", type=int, default=200,
                    help="iterations between checkpoints (default 200)")
+    r = p.add_argument_group(
+        "resilience",
+        "divergence recovery, hardened checkpoints, watchdog, fault "
+        "injection (README 'Resilient solves')",
+    )
+    r.add_argument("--resilient", action="store_true",
+                   help="self-healing solve (--backend xla): in-loop "
+                        "divergence detection plus restart-from-last-good-"
+                        "iterate recovery with precision escalation")
+    r.add_argument("--max-restarts", type=int, default=3,
+                   help="recovery attempts before the resilient solve "
+                        "fails loudly (default 3)")
+    r.add_argument("--escalate-precision",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="allow the resilient solve to move up the "
+                        "bf16->f32->f64 precision ladder after a repeated "
+                        "failure at the same precision (default on)")
+    r.add_argument("--stagnation-window", type=int, default=None,
+                   metavar="ITERS",
+                   help="in-loop stagnation detection: stop after this "
+                        "many iterations without a new best ||dw|| "
+                        "(default: 200 with --resilient, off otherwise)")
+    r.add_argument("--keep-last", type=int, default=2, metavar="K",
+                   help="checkpoint generations to retain for corruption "
+                        "fallback (default 2)")
+    r.add_argument("--heartbeat", metavar="PATH", default=None,
+                   help="write a JSON heartbeat file at every chunk "
+                        "boundary (chunked solvers)")
+    r.add_argument("--watchdog-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="abort with diagnostics if no chunk completes "
+                        "within this window (first chunk includes "
+                        "compile time — size generously)")
+    r.add_argument("--fault-nan-at", type=int, default=None, metavar="K",
+                   help="fault injection: poison the residual with a NaN "
+                        "at the first chunk boundary at/after iteration K")
+    r.add_argument("--fault-preempt-after", type=int, default=None,
+                   metavar="CHUNKS",
+                   help="fault injection: simulate preemption (exit code "
+                        "75) after this many chunks; the checkpoint "
+                        "survives for the resumed run")
+    r.add_argument("--fault-corrupt-checkpoint",
+                   choices=("flip", "truncate", "zero"), default=None,
+                   help="fault injection: damage the newest checkpoint "
+                        "generation on disk before solving (exercises the "
+                        "CRC fallback)")
     p.add_argument("--save-solution", metavar="PATH", default=None,
                    help="write the solution grid to PATH (.npy) — the "
                         "reference never persisted its solution")
@@ -175,7 +221,28 @@ def _pick_backend(args) -> str:
     return "xla"
 
 
-def _run_jax(args, problem: Problem, backend: str):
+def _resilience_kit(args):
+    """Watchdog + fault-injection hook from the CLI flags (None, None when
+    the flags are unused)."""
+    watchdog = None
+    if args.heartbeat or args.watchdog_timeout is not None:
+        from poisson_tpu.parallel.watchdog import Watchdog
+
+        watchdog = Watchdog(heartbeat_path=args.heartbeat,
+                            timeout=args.watchdog_timeout)
+    on_chunk = None
+    if args.fault_nan_at is not None or args.fault_preempt_after is not None:
+        from poisson_tpu.testing.faults import FaultPlan, chunk_hook
+
+        on_chunk = chunk_hook(FaultPlan(
+            nan_at_iteration=args.fault_nan_at,
+            preempt_after_chunks=args.fault_preempt_after,
+        ))
+    return watchdog, on_chunk
+
+
+def _run_jax(args, problem: Problem, backend: str, watchdog=None,
+             on_chunk=None):
     import jax
 
     from poisson_tpu.analysis import l2_error_host
@@ -208,6 +275,16 @@ def _run_jax(args, problem: Problem, backend: str):
                     "--backend pallas-ca-sharded builds its canvases on "
                     "the host; use --backend sharded for --setup device"
                 )
+            # Validate the CA canvas geometry up front so a bad --bm exits
+            # like every other flag-validation path instead of surfacing a
+            # raw ValueError traceback mid-solve.
+            from poisson_tpu.parallel.pallas_ca_sharded import ca_shard_spec
+
+            try:
+                ca_shard_spec(problem, mesh_shape[0], mesh_shape[1],
+                              bm=args.bm)
+            except ValueError as e:
+                raise SystemExit(f"--backend pallas-ca-sharded: {e}")
             if args.checkpoint:
                 from poisson_tpu.parallel.pallas_ca_sharded import (
                     ca_cg_solve_sharded_checkpointed,
@@ -216,7 +293,7 @@ def _run_jax(args, problem: Problem, backend: str):
                 run = lambda: ca_cg_solve_sharded_checkpointed(
                     problem, mesh, args.checkpoint, chunk=args.chunk,
                     bm=args.bm, parallel=args.parallel_grid,
-                    serial=args.serial_reduce,
+                    serial=args.serial_reduce, keep_last=args.keep_last,
                 )
             else:
                 from poisson_tpu.parallel import ca_cg_solve_sharded
@@ -245,6 +322,7 @@ def _run_jax(args, problem: Problem, backend: str):
                 run = lambda: pallas_cg_solve_sharded_checkpointed(
                     problem, mesh, args.checkpoint, chunk=args.chunk,
                     bm=args.bm, parallel=args.parallel_grid, serial=serial,
+                    keep_last=args.keep_last,
                 )
             else:
                 run = lambda: pallas_cg_solve_sharded(
@@ -261,7 +339,9 @@ def _run_jax(args, problem: Problem, backend: str):
 
             run = lambda: pcg_solve_sharded_checkpointed(
                 problem, mesh, args.checkpoint, chunk=args.chunk,
-                dtype=args.dtype,
+                dtype=args.dtype, keep_last=args.keep_last,
+                stagnation_window=args.stagnation_window or 0,
+                watchdog=watchdog, on_chunk=on_chunk,
             )
         else:
             run = lambda: pcg_solve_sharded(
@@ -307,6 +387,7 @@ def _run_jax(args, problem: Problem, backend: str):
             run = lambda: ca_cg_solve_checkpointed(
                 problem, args.checkpoint, chunk=args.chunk, bm=args.bm,
                 parallel=args.parallel_grid, serial=serial,
+                keep_last=args.keep_last,
             )
         else:
             from poisson_tpu.ops.pallas_ca import ca_cg_solve
@@ -329,6 +410,7 @@ def _run_jax(args, problem: Problem, backend: str):
             run = lambda: pallas_cg_solve_checkpointed(
                 problem, args.checkpoint, chunk=args.chunk, bm=args.bm,
                 parallel=args.parallel_grid, bn=args.bn, serial=serial,
+                keep_last=args.keep_last,
             )
         else:
             from poisson_tpu.ops.pallas_cg import pallas_cg_solve
@@ -338,11 +420,33 @@ def _run_jax(args, problem: Problem, backend: str):
                 parallel=args.parallel_grid, serial=serial,
             )
         n_dev = 1
+    elif args.resilient:
+        from poisson_tpu.solvers.resilient import (
+            RecoveryPolicy,
+            pcg_solve_resilient,
+        )
+
+        window = (200 if args.stagnation_window is None
+                  else args.stagnation_window)
+        policy = RecoveryPolicy(
+            max_restarts=args.max_restarts,
+            escalate=args.escalate_precision,
+            stagnation_window=window,
+        )
+        run = lambda: pcg_solve_resilient(
+            problem, dtype=args.dtype, chunk=args.chunk, policy=policy,
+            checkpoint_path=args.checkpoint, keep_last=args.keep_last,
+            watchdog=watchdog, on_chunk=on_chunk,
+        )
+        n_dev = 1
     elif args.checkpoint:
         from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
 
         run = lambda: pcg_solve_checkpointed(
-            problem, args.checkpoint, chunk=args.chunk, dtype=args.dtype
+            problem, args.checkpoint, chunk=args.chunk, dtype=args.dtype,
+            keep_last=args.keep_last,
+            stagnation_window=args.stagnation_window or 0,
+            watchdog=watchdog, on_chunk=on_chunk,
         )
         n_dev = 1
     else:
@@ -448,6 +552,19 @@ def main(argv=None) -> int:
             "--backend xla --checkpoint runs single-device; drop --mesh or "
             "use --backend sharded"
         )
+    resilience_flags = (
+        args.resilient or args.heartbeat
+        or args.watchdog_timeout is not None
+        or args.stagnation_window is not None or args.keep_last != 2
+        or args.fault_nan_at is not None
+        or args.fault_preempt_after is not None
+        or args.fault_corrupt_checkpoint is not None
+    )
+    if resilience_flags and args.backend == "native":
+        raise SystemExit(
+            "the resilience/fault-injection flags drive the JAX chunked "
+            "solvers; not available with --backend native"
+        )
 
     if args.dtype == "float64" and args.backend != "native":
         import jax
@@ -502,7 +619,90 @@ def main(argv=None) -> int:
                     "--serial-reduce accumulates across sequential grid "
                     "steps; it cannot be combined with --parallel-grid"
                 )
-        report, timer, w = _run_jax(args, problem, backend)
+        if args.resilient and backend != "xla":
+            raise SystemExit(
+                f"--resilient drives the single-device xla solve "
+                f"(resolved backend: {backend}); the sharded/pallas "
+                f"chunked paths take the detection, watchdog and "
+                f"checkpoint-hardening flags via --checkpoint"
+            )
+        # The chunk-boundary hooks exist on the XLA chunked drivers; a
+        # resilience flag that cannot reach one must not be silently
+        # dropped (the same no-silent-drop rule the geometry flags follow).
+        hookable = args.resilient or (
+            args.checkpoint and backend in ("xla", "sharded")
+        )
+        if (args.fault_nan_at is not None
+                or args.fault_preempt_after is not None) and not hookable:
+            raise SystemExit(
+                "--fault-nan-at/--fault-preempt-after inject at chunk "
+                "boundaries; use --resilient, or --checkpoint with "
+                f"--backend xla or sharded (resolved backend: {backend})"
+            )
+        if (args.heartbeat or args.watchdog_timeout is not None) \
+                and not hookable:
+            raise SystemExit(
+                "--heartbeat/--watchdog-timeout guard the chunked XLA "
+                "drivers; use --resilient, or --checkpoint with "
+                f"--backend xla or sharded (resolved backend: {backend})"
+            )
+        if args.stagnation_window is not None and not hookable:
+            raise SystemExit(
+                "--stagnation-window needs an in-loop-detecting driver; "
+                "use --resilient, or --checkpoint with --backend xla or "
+                f"sharded (resolved backend: {backend})"
+            )
+        if args.keep_last != 2 and not args.checkpoint:
+            raise SystemExit("--keep-last shapes checkpoint retention; "
+                             "it needs --checkpoint")
+        if args.keep_last < 1:
+            raise SystemExit(f"--keep-last must be >= 1, got {args.keep_last}")
+        if args.fault_corrupt_checkpoint is not None:
+            import os
+
+            if not args.checkpoint:
+                raise SystemExit(
+                    "--fault-corrupt-checkpoint damages the --checkpoint "
+                    "file; pass --checkpoint PATH"
+                )
+            if not os.path.exists(args.checkpoint):
+                raise SystemExit(
+                    f"--fault-corrupt-checkpoint: no checkpoint at "
+                    f"{args.checkpoint} to corrupt (run once with "
+                    f"--checkpoint first)"
+                )
+            from poisson_tpu.testing.faults import corrupt_file
+
+            corrupt_file(args.checkpoint, args.fault_corrupt_checkpoint)
+            print(f"fault injection: corrupted ({args.fault_corrupt_checkpoint}) "
+                  f"checkpoint {args.checkpoint}", file=sys.stderr)
+        watchdog, on_chunk = _resilience_kit(args)
+        try:
+            report, timer, w = _run_jax(args, problem, backend,
+                                        watchdog=watchdog, on_chunk=on_chunk)
+        except KeyboardInterrupt:
+            # The chunked drivers convert a watchdog interrupt into
+            # SolveTimeout; an interrupt that still arrives here raw (e.g.
+            # mid-compile, outside a driver) gets the same treatment.
+            if watchdog is not None and watchdog.fired:
+                print("watchdog timeout: solve aborted (diagnostics next "
+                      "to the heartbeat file)", file=sys.stderr)
+                return 124
+            raise
+        except Exception as e:
+            from poisson_tpu.parallel.watchdog import SolveTimeout
+
+            if isinstance(e, SolveTimeout):
+                print(f"{e}", file=sys.stderr)
+                return 124
+            if on_chunk is not None:
+                from poisson_tpu.testing.faults import PreemptionInjected
+
+                if isinstance(e, PreemptionInjected):
+                    print(f"{e}; checkpoint retained at {args.checkpoint}"
+                          if args.checkpoint else str(e), file=sys.stderr)
+                    return 75   # EX_TEMPFAIL: rerun to resume
+            raise
 
     if args.save_solution:
         np.save(args.save_solution, np.asarray(w, np.float64))

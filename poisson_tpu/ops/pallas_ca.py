@@ -566,7 +566,8 @@ def ca_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                              interpret: bool | None = None,
                              keep_checkpoint: bool = False,
                              parallel: bool = False,
-                             serial: bool | None = None) -> PCGResult:
+                             serial: bool | None = None,
+                             keep_last: int = 2) -> PCGResult:
     """CA solve with periodic state persistence and automatic resume.
 
     Same portable full-grid ``PCGState`` format and (float32, scaled)
@@ -604,7 +605,7 @@ def ca_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
             beta=s.beta, zr=s.rr, diff=s.diff,
         )
 
-    saved = load_state(checkpoint_path, fp)
+    saved = load_state(checkpoint_path, fp, keep_last=keep_last)
     if saved is None:
         s = _ca_init(problem, cv, rhs)
     else:
@@ -620,7 +621,7 @@ def ca_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                                      parallel, serial, cs, cw, g, sc2, st),
         to_portable=to_portable,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
-        keep_checkpoint=keep_checkpoint,
+        keep_checkpoint=keep_checkpoint, keep_last=keep_last,
     )
 
     M, N = problem.M, problem.N

@@ -147,9 +147,19 @@ def _is_megacore(platform: str, device_kind: str) -> bool:
     across TensorCores only on megacore chips (two cores fused behind one
     device: v4, v5p). Single-core parts (v5e/v6e "lite") and pre-megacore
     chips (v2/v3 expose each core as its own device) execute the grid on
-    one core, where the shared-partial-window question cannot arise."""
+    one core, where the shared-partial-window question cannot arise.
+
+    libtpu has reported v5p chips with device_kind 'TPU v5' — no 'p'
+    suffix at all — while v5e parts carry 'lite' or the explicit 'v5e'
+    spelling. Matching 'v5p' alone therefore missed real v5p hardware,
+    the one device class this predicate exists for; treat any v5 that is
+    not a lite/e part as megacore."""
+    if platform != "tpu":
+        return False
     kind = device_kind.lower()
-    return platform == "tpu" and ("v4" in kind or "v5p" in kind)
+    if "v4" in kind:
+        return True
+    return "v5" in kind and "lite" not in kind and "v5e" not in kind
 
 
 def _is_megacore_device() -> bool:
@@ -1075,7 +1085,8 @@ def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                                  keep_checkpoint: bool = False,
                                  parallel: bool = False,
                                  bn: int | None = None,
-                                 serial: bool | None = None) -> PCGResult:
+                                 serial: bool | None = None,
+                                 keep_last: int = 2) -> PCGResult:
     """Fused-path solve with periodic state persistence and automatic
     resume — interoperable with the XLA fp32-scaled checkpoints (module
     comment above). fp32 only, like the fused path itself. The portable
@@ -1097,7 +1108,7 @@ def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     )
     fp = _fingerprint(problem, "float32", True)
 
-    saved = load_state(checkpoint_path, fp)
+    saved = load_state(checkpoint_path, fp, keep_last=keep_last)
     if saved is None:
         s = _fused_init(cv, rhs)
         s = s._replace(zr=s.zr * jnp.float32(problem.h1 * problem.h2))
@@ -1110,7 +1121,7 @@ def pallas_cg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                                         parallel, serial, cs, cw, g, sc2, st),
         to_portable=lambda st: _fused_to_pcg_state(problem, cv, st),
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
-        keep_checkpoint=keep_checkpoint,
+        keep_checkpoint=keep_checkpoint, keep_last=keep_last,
     )
 
     M, N = problem.M, problem.N

@@ -1,8 +1,11 @@
 from poisson_tpu.parallel.checkpoint_sharded import pcg_solve_sharded_checkpointed
 from poisson_tpu.parallel.mesh import choose_process_grid, make_solver_mesh
 from poisson_tpu.parallel.pcg_sharded import pcg_solve_sharded
+from poisson_tpu.parallel.watchdog import SolveTimeout, Watchdog
 
 __all__ = [
+    "SolveTimeout",
+    "Watchdog",
     "ca_cg_solve_sharded",
     "choose_process_grid",
     "make_solver_mesh",

@@ -62,6 +62,7 @@ from poisson_tpu.solvers.pcg import (
     resolve_dtype,
     resolve_scaled,
 )
+from poisson_tpu.utils.compat import shard_map
 
 _STACKED = P((X_AXIS, Y_AXIS))   # (P, m̂+2, n̂+2) field blocks, mesh order
 _BLOCKED = P(X_AXIS, Y_AXIS)     # (Px·m̂, Py·n̂) padded-global state arrays
@@ -121,11 +122,12 @@ def _geometry(problem: Problem, mesh: Mesh):
 def _interiors(s: PCGState):
     inner = lambda x: x[1:-1, 1:-1]
     return (inner(s.w), inner(s.r), inner(s.z), inner(s.p),
-            s.k, s.done, s.zr, s.diff)
+            s.k, s.done, s.zr, s.diff, s.flag, s.best, s.stall)
 
 
 def _state_specs():
-    return (_BLOCKED, _BLOCKED, _BLOCKED, _BLOCKED, P(), P(), P(), P())
+    return (_BLOCKED, _BLOCKED, _BLOCKED, _BLOCKED,
+            P(), P(), P(), P(), P(), P(), P())
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
@@ -141,34 +143,38 @@ def _init_sharded(problem: Problem, mesh: Mesh, scaled: bool,
         ops = _sharded_ops(problem, a, b, aux, mask, px_size, py_size, scaled)
         return _interiors(init_state(ops, rhs * mask))
 
-    out = jax.shard_map(
+    out = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(_STACKED, _STACKED, _STACKED, _STACKED),
         out_specs=_state_specs(),
         check_vma=False,
     )(a_blk, b_blk, rhs_blk, aux_blk)
-    w, r, z, p, k, done, zr, diff = out
-    return PCGState(k=k, done=done, w=w, r=r, z=z, p=p, zr=zr, diff=diff)
+    w, r, z, p, k, done, zr, diff, flag, best, stall = out
+    return PCGState(k=k, done=done, w=w, r=r, z=z, p=p, zr=zr, diff=diff,
+                    flag=flag, best=best, stall=stall)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _chunk_sharded(problem: Problem, mesh: Mesh, scaled: bool, chunk: int,
+                   stagnation_window: int,
                    a_blk, b_blk, aux_blk, state: PCGState) -> PCGState:
     """Advance the sharded solve by at most ``chunk`` iterations."""
     px_size, py_size, m_blk, n_blk = _geometry(problem, mesh)
 
-    def shard_fn(a, b, aux, w, r, z, p, k, done, zr, diff):
+    def shard_fn(a, b, aux, w, r, z, p, k, done, zr, diff, flag, best, stall):
         a, b, aux = a[0], b[0], aux[0]
         mask, _, _ = _owned_mask(problem, m_blk, n_blk, a.dtype)
         ops = _sharded_ops(problem, a, b, aux, mask, px_size, py_size, scaled)
         body = make_pcg_body(
             ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
             h1=problem.h1, h2=problem.h2,
+            stagnation_window=stagnation_window,
         )
         pad1 = lambda x: jnp.pad(x, 1)   # zero halo ring (exact: see module doc)
         s0 = PCGState(k=k, done=done, w=pad1(w), r=pad1(r), z=pad1(z),
-                      p=pad1(p), zr=zr, diff=diff)
+                      p=pad1(p), zr=zr, diff=diff,
+                      flag=flag, best=best, stall=stall)
         stop_at = jnp.minimum(k + chunk, problem.iteration_cap)
 
         def cond(s: PCGState):
@@ -176,16 +182,18 @@ def _chunk_sharded(problem: Problem, mesh: Mesh, scaled: bool, chunk: int,
 
         return _interiors(lax.while_loop(cond, body, s0))
 
-    out = jax.shard_map(
+    out = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(_STACKED, _STACKED, _STACKED) + _state_specs(),
         out_specs=_state_specs(),
         check_vma=False,
     )(a_blk, b_blk, aux_blk, state.w, state.r, state.z, state.p,
-      state.k, state.done, state.zr, state.diff)
-    w, r, z, p, k, done, zr, diff = out
-    return PCGState(k=k, done=done, w=w, r=r, z=z, p=p, zr=zr, diff=diff)
+      state.k, state.done, state.zr, state.diff,
+      state.flag, state.best, state.stall)
+    w, r, z, p, k, done, zr, diff, flag, best, stall = out
+    return PCGState(k=k, done=done, w=w, r=r, z=z, p=p, zr=zr, diff=diff,
+                    flag=flag, best=best, stall=stall)
 
 
 def _to_full_grid(state: PCGState, problem: Problem) -> PCGState:
@@ -222,22 +230,36 @@ def _to_padded_global(state: PCGState, problem: Problem, gm: int, gn: int,
     return state._replace(w=padded(state.w), r=padded(state.r),
                           z=padded(state.z), p=padded(state.p),
                           k=scalar(state.k), done=scalar(state.done),
-                          zr=scalar(state.zr), diff=scalar(state.diff))
+                          zr=scalar(state.zr), diff=scalar(state.diff),
+                          flag=scalar(state.flag), best=scalar(state.best),
+                          stall=scalar(state.stall))
 
 
 def pcg_solve_sharded_checkpointed(problem: Problem, mesh: Mesh,
                                    checkpoint_path: str, chunk: int = 200,
                                    dtype=None, scaled=None,
-                                   keep_checkpoint: bool = False) -> PCGResult:
+                                   keep_checkpoint: bool = False,
+                                   keep_last: int = 2,
+                                   stagnation_window: int = 0,
+                                   watchdog=None,
+                                   on_chunk=None) -> PCGResult:
     """Distributed solve with periodic state persistence and automatic resume.
 
     Chunked counterpart of ``pcg_solve_sharded`` (host setup): every
     ``chunk`` iterations the gathered CG state is written to
-    ``checkpoint_path`` (atomic replace); an existing checkpoint with the
-    same problem fingerprint is resumed — including one written by the
-    single-device ``pcg_solve_checkpointed`` or by a run on a different
-    mesh shape. On convergence the checkpoint is removed unless
-    ``keep_checkpoint``; an unconverged cap-hit keeps it.
+    ``checkpoint_path`` (atomic replace, CRC-sealed, ``keep_last``
+    generations retained — see ``solvers.checkpoint.save_state``); an
+    existing checkpoint with the same problem fingerprint is resumed —
+    including one written by the single-device ``pcg_solve_checkpointed``
+    or by a run on a different mesh shape, and falling back to an older
+    generation if the newest is corrupt. On convergence the checkpoint is
+    removed unless ``keep_checkpoint``; an unconverged cap-hit (or a
+    divergence stop — see ``PCGResult.flag``) keeps it.
+
+    ``watchdog`` (``parallel.watchdog.Watchdog``) is beaten at every chunk
+    boundary — the heartbeat/timeout guard for wedged collectives on the
+    multihost path. ``on_chunk(state, chunks_done)`` runs after each
+    persisted chunk (fault injection uses this; see ``testing.faults``).
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -269,10 +291,12 @@ def pcg_solve_sharded_checkpointed(problem: Problem, mesh: Mesh,
     state = run_chunked(
         state,
         advance=lambda s: _chunk_sharded(problem, mesh, use_scaled, chunk,
+                                         stagnation_window,
                                          a_blk, b_blk, aux_blk, s),
         to_portable=lambda s: _to_full_grid(_fetchable(s, mesh), problem),
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint, primary=is_primary, sync=_sync,
+        keep_last=keep_last, watchdog=watchdog, on_chunk=on_chunk,
     )
 
     # Solution extraction, matching pcg_solve_sharded: unscale with the same
@@ -283,5 +307,5 @@ def pcg_solve_sharded_checkpointed(problem: Problem, mesh: Mesh,
         w_y = w_y * np.asarray(aux64, w_y.dtype)
     return PCGResult(
         w=jnp.asarray(w_y), iterations=state.k, diff=state.diff,
-        residual_dot=state.zr,
+        residual_dot=state.zr, flag=state.flag,
     )

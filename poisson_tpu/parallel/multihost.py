@@ -30,14 +30,37 @@ transport, which is XLA's, not ours.
 
 from __future__ import annotations
 
+import time
+import warnings
 from typing import Optional
 
 import jax
 
+# Coordinator-connection failures that are worth retrying: the coordinator
+# process on host 0 races every other host's startup, so early connection
+# refusals/timeouts are expected during a pod launch (and during recovery
+# from a preempted host) — they are not configuration errors.
+_TRANSIENT_MARKERS = (
+    "connect", "connection", "timeout", "timed out", "deadline",
+    "unavailable", "refused", "temporar", "reset", "barrier",
+)
+
+
+def _is_transient(message: str) -> bool:
+    msg = message.lower()
+    if "already" in msg or "once" in msg:
+        return False            # runtime formed elsewhere: not a failure
+    if "backend" in msg or "before" in msg:
+        return False            # ordering mistake: retrying cannot fix it
+    return any(marker in msg for marker in _TRANSIENT_MARKERS)
+
 
 def initialize_multihost(coordinator: Optional[str] = None,
                          num_processes: Optional[int] = None,
-                         process_id: Optional[int] = None) -> int:
+                         process_id: Optional[int] = None,
+                         max_retries: int = 3,
+                         backoff_seconds: float = 1.0,
+                         sleep=time.sleep) -> int:
     """Join (or form) the distributed runtime; returns this process's index.
 
     With no arguments, relies on the environment (TPU pods populate
@@ -48,37 +71,71 @@ def initialize_multihost(coordinator: Optional[str] = None,
     silently degrading to per-host solo solves. Calling again after a
     successful init, or in a single-process environment with no cluster
     configuration, is a harmless no-op.
+
+    Transient failures (coordinator not yet listening, connection timeout —
+    normal during a racing pod launch or a recovery restart) are retried
+    ``max_retries`` times with exponential backoff starting at
+    ``backoff_seconds``. When the retries are exhausted: an explicitly
+    requested cluster (``coordinator`` given) raises — the caller asked for
+    a specific world and silently not getting it would corrupt the run —
+    while an env-driven init degrades gracefully to a single-host run with
+    a warning, so a solve can still make progress on local devices.
     """
     global _initialized
     if _initialized:
         return jax.process_index()  # documented no-op on a second call
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as e:
-        msg = str(e).lower()
-        if "already" in msg or "once" in msg:
-            pass  # runtime formed elsewhere: keep it
-        elif "backend" in msg or "before" in msg:
-            raise RuntimeError(
-                "initialize_multihost() must be the first JAX call in the "
-                "process — the XLA backend is already initialized, so the "
-                "distributed runtime can no longer form. Move the call "
-                "ahead of any jax.devices()/jnp use."
-            ) from e
-        elif coordinator is None and (
-            "coordinator" in msg or "environment" in msg or "detect" in msg
-        ):
-            pass  # no cluster configured: single-process run
-        else:
-            raise
-    except ValueError:
-        if coordinator is not None:
-            raise  # explicit-cluster arguments were wrong: surface it
-        # No cluster in the environment: single-process run.
+    attempt = 0
+    while True:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as e:
+            msg = str(e).lower()
+            if "already" in msg or "once" in msg:
+                pass  # runtime formed elsewhere: keep it
+            elif "backend" in msg or "before" in msg:
+                raise RuntimeError(
+                    "initialize_multihost() must be the first JAX call in "
+                    "the process — the XLA backend is already initialized, "
+                    "so the distributed runtime can no longer form. Move "
+                    "the call ahead of any jax.devices()/jnp use."
+                ) from e
+            elif _is_transient(msg) and attempt < max_retries:
+                attempt += 1
+                delay = backoff_seconds * (2.0 ** (attempt - 1))
+                warnings.warn(
+                    f"distributed init failed transiently ({e}); retry "
+                    f"{attempt}/{max_retries} in {delay:.1f}s",
+                    RuntimeWarning, stacklevel=2,
+                )
+                sleep(delay)
+                continue
+            elif coordinator is None and _is_transient(msg):
+                # Env-driven cluster that never came up (retries spent):
+                # degrade rather than wedge every host on a dead
+                # coordinator. Checked before the quiet no-cluster branch —
+                # transient messages often mention the coordinator too.
+                warnings.warn(
+                    f"distributed init still failing after {max_retries} "
+                    f"retries ({e}); continuing single-host — this "
+                    "process will only see its local devices",
+                    RuntimeWarning, stacklevel=2,
+                )
+            elif coordinator is None and (
+                "coordinator" in msg or "environment" in msg
+                or "detect" in msg
+            ):
+                pass  # no cluster configured: single-process run
+            else:
+                raise
+        except ValueError:
+            if coordinator is not None:
+                raise  # explicit-cluster arguments were wrong: surface it
+            # No cluster in the environment: single-process run.
+        break
     _initialized = True
     return jax.process_index()
 

@@ -73,6 +73,7 @@ from poisson_tpu.ops.pallas_cg import (
 from poisson_tpu.parallel.halo import _shift_down, _shift_up
 from poisson_tpu.parallel.mesh import X_AXIS, Y_AXIS
 from poisson_tpu.solvers.pcg import PCGResult
+from poisson_tpu.utils.compat import shard_map
 
 _AXES = (X_AXIS, Y_AXIS)
 _RING = 2          # halo ring width (the s=2 stencil depth)
@@ -296,7 +297,7 @@ def _ca_solve_sharded(problem: Problem, mesh: Mesh, spec: CAShardSpec,
         )
 
     stacked = P((X_AXIS, Y_AXIS))
-    w_int, k, diff, rr = jax.shard_map(
+    w_int, k, diff, rr = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(stacked, stacked, stacked, stacked, stacked, stacked,
@@ -383,7 +384,7 @@ def _ca_chunk_sharded(problem: Problem, mesh: Mesh, spec: CAShardSpec,
 
     stacked = P((X_AXIS, Y_AXIS))
     rep = P()
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(stacked, stacked, stacked, stacked, rep,
@@ -403,7 +404,7 @@ def _ca_init_stacked(problem: Problem, mesh: Mesh, spec: CAShardSpec,
                 s.k, s.done, s.rr, s.beta, s.diff)
 
     stacked = P((X_AXIS, Y_AXIS))
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(stacked, P()),
@@ -418,7 +419,8 @@ def ca_cg_solve_sharded_checkpointed(
         interpret: bool | None = None,
         keep_checkpoint: bool = False,
         parallel: bool = False,
-        serial: bool | None = None) -> PCGResult:
+        serial: bool | None = None,
+        keep_last: int = 2) -> PCGResult:
     """Distributed CA solve with periodic state persistence and automatic
     resume (portable cross-backend, cross-mesh, cross-ALGORITHM format —
     module comment above). fp32 only. All scaffolding is the shared
@@ -455,4 +457,5 @@ def ca_cg_solve_sharded_checkpointed(
     return run_sharded_checkpointed(
         problem, mesh, checkpoint_path, chunk, keep_checkpoint, spec,
         _COL0, (cs, cw, g, rhs, sc2, colmask), make_runners,
+        keep_last=keep_last,
     )

@@ -65,6 +65,7 @@ from poisson_tpu.ops.pallas_cg import (
 )
 from poisson_tpu.parallel.mesh import X_AXIS, Y_AXIS
 from poisson_tpu.solvers.pcg import PCGResult, _DENOM_TOL
+from poisson_tpu.utils.compat import shard_map
 
 _AXES = (X_AXIS, Y_AXIS)
 
@@ -296,7 +297,7 @@ def _solve(problem: Problem, mesh: Mesh, spec: ShardSpec, interpret: bool,
         )
 
     stacked = P((X_AXIS, Y_AXIS))
-    w_int, k, diff, zr = jax.shard_map(
+    w_int, k, diff, zr = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(stacked, stacked, stacked, stacked, stacked, stacked, P()),
@@ -426,7 +427,7 @@ def _chunk_solve(problem: Problem, mesh: Mesh, spec: ShardSpec,
 
     stacked = P((X_AXIS, Y_AXIS))
     rep = P()
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(stacked, stacked, stacked, stacked, rep,
@@ -446,7 +447,7 @@ def _init_stacked(problem: Problem, mesh: Mesh, spec: ShardSpec,
 
     stacked = P((X_AXIS, Y_AXIS))
     rep = P()
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(stacked, rep),
@@ -475,7 +476,8 @@ class _CkptState(NamedTuple):
 def run_sharded_checkpointed(problem: Problem, mesh: Mesh,
                              checkpoint_path: str, chunk: int,
                              keep_checkpoint: bool, spec, col0: int,
-                             canvases, make_runners) -> PCGResult:
+                             canvases, make_runners,
+                             keep_last: int = 2) -> PCGResult:
     """Shared scaffolding for the sharded checkpointed drivers (fused and
     CA — one copy of the multi-process wrapping, portable-state mapping,
     gather/scatter plumbing, and final unscale).
@@ -543,7 +545,7 @@ def run_sharded_checkpointed(problem: Problem, mesh: Mesh,
             diff=scalar(full_state.diff, np.float32),
         )
 
-    saved = load_state(checkpoint_path, fp)
+    saved = load_state(checkpoint_path, fp, keep_last=keep_last)
     state = init() if saved is None else stacked_state(saved)
 
     def fetch(x):
@@ -567,6 +569,7 @@ def run_sharded_checkpointed(problem: Problem, mesh: Mesh,
         to_portable=to_portable,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint, primary=is_primary, sync=_sync,
+        keep_last=keep_last,
     )
 
     # Solution: gather owned w interiors and unscale with sc on the host
@@ -585,9 +588,11 @@ def pallas_cg_solve_sharded_checkpointed(
         interpret: bool | None = None,
         keep_checkpoint: bool = False,
         parallel: bool = False,
-        serial: bool | None = None) -> PCGResult:
+        serial: bool | None = None,
+        keep_last: int = 2) -> PCGResult:
     """Distributed fused-path solve with periodic state persistence and
-    automatic resume (portable format — see module comment). fp32 only."""
+    automatic resume (portable format — see module comment; hardened
+    format with ``keep_last`` retained generations). fp32 only."""
     serial = _resolve_serial(serial, parallel)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -612,6 +617,6 @@ def pallas_cg_solve_sharded_checkpointed(
 
     return run_sharded_checkpointed(
         problem, mesh, checkpoint_path, chunk, keep_checkpoint, spec, 1,
-        (cs, cw, g, rhs, sc2, colmask), make_runners,
+        (cs, cw, g, rhs, sc2, colmask), make_runners, keep_last=keep_last,
     )
 

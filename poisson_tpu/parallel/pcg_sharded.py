@@ -56,6 +56,7 @@ from poisson_tpu.solvers.pcg import (
     resolve_dtype,
     resolve_scaled,
 )
+from poisson_tpu.utils.compat import shard_map
 
 
 def _owned_mask(problem: Problem, m_blk: int, n_blk: int, dtype):
@@ -177,9 +178,11 @@ def _run_shard(problem: Problem, a, b, rhs, aux, mask, px_size, py_size,
         h1=problem.h1, h2=problem.h2,
     )
     w = s.w * aux if scaled else s.w
-    # Every shard returns its owned interior block; k/diff/zr are
-    # mesh-replicated scalars.
-    return w[1:-1, 1:-1], s.k, s.diff, s.zr
+    # Every shard returns its owned interior block; k/diff/zr/flag are
+    # mesh-replicated scalars (the ops psum every reduction, so all shards
+    # compute the same convergence/divergence verdict in step — the
+    # reference's synchronized termination extended to failure modes).
+    return w[1:-1, 1:-1], s.k, s.diff, s.zr, s.flag
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
@@ -199,15 +202,15 @@ def _solve_device_setup(problem: Problem, mesh: Mesh, dtype_name: str,
             problem, a, b, rhs, aux, mask, px_size, py_size, scaled
         )
 
-    w_int, k, diff, zr = jax.shard_map(
+    w_int, k, diff, zr, flag = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(),
-        out_specs=(P(X_AXIS, Y_AXIS), P(), P(), P()),
+        out_specs=(P(X_AXIS, Y_AXIS), P(), P(), P(), P()),
         check_vma=False,
     )()
     w = pad_interior(w_int[: problem.M - 1, : problem.N - 1])
-    return PCGResult(w=w, iterations=k, diff=diff, residual_dot=zr)
+    return PCGResult(w=w, iterations=k, diff=diff, residual_dot=zr, flag=flag)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
@@ -230,15 +233,15 @@ def _solve_host_setup(problem: Problem, mesh: Mesh, dtype_name: str,
         )
 
     spec = P((X_AXIS, Y_AXIS))
-    w_int, k, diff, zr = jax.shard_map(
+    w_int, k, diff, zr, flag = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec),
-        out_specs=(P(X_AXIS, Y_AXIS), P(), P(), P()),
+        out_specs=(P(X_AXIS, Y_AXIS), P(), P(), P(), P()),
         check_vma=False,
     )(a_blk, b_blk, rhs_blk, aux_blk)
     w = pad_interior(w_int[: problem.M - 1, : problem.N - 1])
-    return PCGResult(w=w, iterations=k, diff=diff, residual_dot=zr)
+    return PCGResult(w=w, iterations=k, diff=diff, residual_dot=zr, flag=flag)
 
 
 def pcg_solve_sharded(problem: Problem, mesh: Mesh, dtype=None, scaled=None,

@@ -3,14 +3,22 @@ from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
 from poisson_tpu.solvers.history import pcg_solve_history
 from poisson_tpu.solvers.pcg import PCGResult, pcg_solve, pcg_step_fn
 from poisson_tpu.solvers.refine import RefineResult, refined_solve
+from poisson_tpu.solvers.resilient import (
+    DivergenceError,
+    RecoveryPolicy,
+    pcg_solve_resilient,
+)
 
 __all__ = [
+    "DivergenceError",
     "PCGResult",
+    "RecoveryPolicy",
     "RefineResult",
     "differentiable_solve",
     "pcg_solve",
     "pcg_solve_checkpointed",
     "pcg_solve_history",
+    "pcg_solve_resilient",
     "pcg_step_fn",
     "refined_solve",
 ]

@@ -13,6 +13,20 @@ are identical to round-off.
 Format: a single ``.npz`` (numpy, host-side) with a problem fingerprint;
 a mismatched fingerprint refuses to resume rather than silently solving a
 different problem.
+
+Hardening (this layer is the recovery path, so it must survive the same
+faults it exists for):
+
+- writes are atomic (tmp + ``os.replace``) and CRC-sealed — a payload
+  checksum over every array is stored in the file and verified on load, so
+  a truncated or bit-flipped checkpoint is *detected*, never resumed;
+- the previous ``keep_last − 1`` generations are retained as
+  ``<path>.1 ≥ <path>.2 ≥ …`` (newest first) and ``load_state`` falls back
+  through them when the newest generation is corrupt or was written for a
+  different problem;
+- a state whose in-loop verdict is FLAG_NONFINITE is never persisted —
+  the last good generation survives a divergence for the recovery driver
+  (``solvers.resilient``) to restart from.
 """
 
 from __future__ import annotations
@@ -20,6 +34,8 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import warnings
+import zlib
 from typing import Optional
 
 import jax
@@ -29,6 +45,8 @@ from jax import lax
 
 from poisson_tpu.config import Problem
 from poisson_tpu.solvers.pcg import (
+    FLAG_CONVERGED,
+    FLAG_NONFINITE,
     PCGResult,
     PCGState,
     host_setup,
@@ -40,7 +58,18 @@ from poisson_tpu.solvers.pcg import (
     single_device_ops,
 )
 
-_STATE_KEYS = ("k", "done", "w", "r", "z", "p", "zr", "diff")
+_STATE_KEYS = ("k", "done", "w", "r", "z", "p", "zr", "diff",
+               "flag", "best", "stall")
+# Verdict fields are absent in checkpoints written before hardening (and
+# in portable states produced by the fused solvers); they resume as a
+# clean slate rather than failing the load.
+_OPTIONAL_DEFAULTS = {"flag": np.int32(0), "best": np.inf,
+                      "stall": np.int32(0)}
+
+
+class CorruptCheckpointError(RuntimeError):
+    """The checkpoint file exists but cannot be trusted: unreadable npz,
+    missing payload keys, or CRC mismatch."""
 
 
 def _fingerprint(problem: Problem, dtype_name: str, scaled: bool) -> str:
@@ -55,8 +84,9 @@ def _fingerprint(problem: Problem, dtype_name: str, scaled: bool) -> str:
     return repr((sorted(fields.items()), dtype_name, scaled))
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def _run_chunk(problem: Problem, scaled: bool, chunk: int,
+               stagnation_window: int,
                a, b, aux, state: PCGState) -> PCGState:
     """Advance the solve by at most ``chunk`` iterations (device-resident)."""
     ops = (
@@ -67,6 +97,7 @@ def _run_chunk(problem: Problem, scaled: bool, chunk: int,
     body = make_pcg_body(
         ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2,
+        stagnation_window=stagnation_window,
     )
     stop_at = jnp.minimum(state.k + chunk, problem.iteration_cap)
 
@@ -76,8 +107,27 @@ def _run_chunk(problem: Problem, scaled: bool, chunk: int,
     return lax.while_loop(cond, body, state)
 
 
+def _state_flag(state) -> Optional[int]:
+    """Termination verdict of any solver state, or None for state types
+    (the fused pallas loops) that do not track one."""
+    flag = getattr(state, "flag", None)
+    return None if flag is None else int(flag)
+
+
+def _converged(state) -> bool:
+    """True only for a genuinely converged stop. Solvers with verdict
+    tracking require FLAG_CONVERGED — a breakdown/divergence/stagnation
+    stop also sets ``done`` but must keep its checkpoint for recovery;
+    verdict-less states keep the historical done-means-converged reading."""
+    if not bool(state.done):
+        return False
+    flag = _state_flag(state)
+    return True if flag is None else flag == FLAG_CONVERGED
+
+
 def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
-                cap: int, keep_checkpoint: bool, primary=None, sync=None):
+                cap: int, keep_checkpoint: bool, primary=None, sync=None,
+                keep_last: int = 2, watchdog=None, on_chunk=None):
     """The one chunked-checkpoint driver loop, shared by all four
     checkpointed solvers (single/sharded × XLA/fused): advance until done
     or cap, persist the portable full-grid state after every chunk, clean
@@ -88,65 +138,242 @@ def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
     writes. ``primary``/``sync`` gate the file write to one process and
     barrier-order it against other processes' later reads (multi-process
     meshes); they default to single-process no-ops.
+
+    Resilience hooks:
+
+    - ``keep_last`` generations of the checkpoint are retained (see
+      :func:`save_state`);
+    - ``watchdog`` (``parallel.watchdog.Watchdog``) is armed for the whole
+      loop and beaten at every chunk boundary — a chunk that wedges (the
+      multihost collective hang this repo has lived through) trips its
+      timeout instead of stalling silently forever;
+    - ``on_chunk(state, chunks_done)`` runs after each chunk is persisted
+      and may return a replacement state or raise (fault injection — see
+      ``testing.faults``);
+    - a state that went non-finite is *not* persisted and the stop is not
+      treated as convergence: the newest good generation survives for the
+      recovery driver.
     """
     primary = primary if primary is not None else (lambda: True)
     sync = sync if sync is not None else (lambda name: None)
-    while (not bool(state.done)) and int(state.k) < cap:
-        state = advance(state)
-        jax.block_until_ready(state)
-        if bool(state.done) and not keep_checkpoint:
-            # The chunk just converged and the file would be deleted below:
-            # skip the full-grid gather (an all-gather collective on
-            # multi-process meshes) and the disk write outright. ``done`` is
-            # replicated, so every process skips in step.
-            break
-        portable = to_portable(state)   # collective when multi-process
-        if primary():
-            save_state(path, portable, fingerprint)
-        sync("poisson_ckpt_save")       # write lands before anyone reads it
-    if bool(state.done) and not keep_checkpoint and primary() \
-            and os.path.exists(path):
-        os.remove(path)
+    if watchdog is not None:
+        watchdog.start()
+    chunks_done = 0
+    try:
+        while (not bool(state.done)) and int(state.k) < cap:
+            state = advance(state)
+            jax.block_until_ready(state)
+            chunks_done += 1
+            if watchdog is not None:
+                watchdog.beat(k=int(state.k), diff=float(state.diff))
+            flag = _state_flag(state)
+            if flag == FLAG_NONFINITE:
+                # Poisoned state: saving it would overwrite the last good
+                # generation with NaNs. ``flag`` is mesh-replicated, so
+                # every process skips in step.
+                break
+            if _converged(state) and not keep_checkpoint:
+                # The chunk just converged and the file would be deleted
+                # below: skip the full-grid gather (an all-gather collective
+                # on multi-process meshes) and the disk write outright.
+                break
+            portable = to_portable(state)   # collective when multi-process
+            if primary():
+                save_state(path, portable, fingerprint, keep_last=keep_last)
+            sync("poisson_ckpt_save")   # write lands before anyone reads it
+            if on_chunk is not None:
+                state = _apply_hook(on_chunk, state, chunks_done)
+    except KeyboardInterrupt:
+        if watchdog is not None:
+            watchdog.raise_if_fired()   # timeout → typed SolveTimeout
+        raise
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+    if _converged(state) and not keep_checkpoint and primary():
+        for candidate in checkpoint_generations(path, keep_last):
+            if os.path.exists(candidate):
+                os.remove(candidate)
     sync("poisson_ckpt_done")           # removal precedes any follow-up solve
     return state
 
 
-def save_state(path: str, state: PCGState, fingerprint: str) -> None:
+def _apply_hook(on_chunk, state, chunks_done):
+    replacement = on_chunk(state, chunks_done)
+    return state if replacement is None else replacement
+
+
+def checkpoint_generations(path: str, keep_last: int = 2) -> list:
+    """Candidate checkpoint paths, newest first: ``path``, ``path.1``, …"""
+    keep_last = max(1, int(keep_last))
+    return [path] + [f"{path}.{i}" for i in range(1, keep_last)]
+
+
+def _payload_crc(fingerprint: str, arrays: dict) -> int:
+    crc = zlib.crc32(fingerprint.encode())
+    for key in sorted(arrays):
+        a = np.ascontiguousarray(arrays[key])
+        crc = zlib.crc32(key.encode(), crc)
+        crc = zlib.crc32(str(a.dtype).encode(), crc)
+        crc = zlib.crc32(str(a.shape).encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def save_state(path: str, state: PCGState, fingerprint: str,
+               keep_last: int = 2) -> None:
+    """Atomically persist ``state``: write to a tmp file, seal it with a
+    CRC32 over the full payload, rotate the previous generations
+    (``path`` → ``path.1`` → …, keeping ``keep_last`` total), then
+    ``os.replace`` into place. A kill at any point leaves either the old
+    generation chain or the new one — never a partial file at ``path``."""
     arrays = {key: np.asarray(val) for key, val in zip(_STATE_KEYS, state)}
     # np.savez appends '.npz' to names without it — keep the temp name
     # suffixed so the atomic-replace source path is what savez wrote.
     tmp = f"{path}.{os.getpid()}.tmp.npz"
-    np.savez(tmp, fingerprint=np.asarray(fingerprint), **arrays)
-    os.replace(tmp, path)
+    try:
+        np.savez(
+            tmp,
+            fingerprint=np.asarray(fingerprint),
+            crc32=np.uint32(_payload_crc(fingerprint, arrays)),
+            **arrays,
+        )
+        generations = checkpoint_generations(path, keep_last)
+        for older, newer in zip(reversed(generations[1:]),
+                                reversed(generations[:-1])):
+            if os.path.exists(newer):
+                os.replace(newer, older)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):   # savez died mid-write: no partials left
+            os.remove(tmp)
 
 
-def load_state(path: str, fingerprint: str) -> Optional[PCGState]:
-    """Returns the saved state, or None if absent; raises on a
-    fingerprint mismatch (wrong problem/precision for this checkpoint)."""
-    if not os.path.exists(path):
-        return None
-    with np.load(path) as data:
-        saved = str(data["fingerprint"])
-        if saved != fingerprint:
-            raise ValueError(
-                f"checkpoint {path} was written for a different problem "
-                f"configuration:\n  saved:     {saved}\n  requested: "
-                f"{fingerprint}"
+def _read_state(path: str, fingerprint: str) -> PCGState:
+    """Read and verify one checkpoint file. Raises CorruptCheckpointError
+    for anything untrustworthy, ValueError for a fingerprint mismatch."""
+    try:
+        with np.load(path) as data:
+            if "fingerprint" not in data:
+                raise CorruptCheckpointError(
+                    f"checkpoint {path} has no fingerprint record"
+                )
+            saved = str(data["fingerprint"])
+            vals = {}
+            for key in _STATE_KEYS:
+                if key in data:
+                    vals[key] = data[key]
+                elif key in _OPTIONAL_DEFAULTS:
+                    vals[key] = np.asarray(_OPTIONAL_DEFAULTS[key])
+                else:
+                    raise CorruptCheckpointError(
+                        f"checkpoint {path} is missing state array {key!r}"
+                    )
+            stored_crc = int(data["crc32"]) if "crc32" in data else None
+    except CorruptCheckpointError:
+        raise
+    except Exception as e:
+        # Anything raised while parsing the file is corruption: np.load
+        # surfaces truncated zips as ValueError/OSError, but a bit-flip in
+        # an npy *header* escapes as SyntaxError/TokenError from numpy's
+        # header parser — the failure set is open-ended by construction.
+        # (The fingerprint-mismatch ValueError is raised after this block.)
+        raise CorruptCheckpointError(
+            f"checkpoint {path} is unreadable: {type(e).__name__}: {e}"
+        ) from e
+    if saved != fingerprint:
+        raise ValueError(
+            f"checkpoint {path} was written for a different problem "
+            f"configuration:\n  saved:     {saved}\n  requested: "
+            f"{fingerprint}"
+        )
+    if stored_crc is not None:
+        actual = _payload_crc(saved, {k: np.asarray(v)
+                                      for k, v in vals.items()})
+        if actual != stored_crc:
+            raise CorruptCheckpointError(
+                f"checkpoint {path} failed its integrity check "
+                f"(stored CRC32 {stored_crc:#010x}, payload "
+                f"{actual:#010x}) — the file was corrupted after writing"
             )
-        vals = {key: data[key] for key in _STATE_KEYS}
+    # Normalize the scalar dtypes so a resumed while_loop carry is stable
+    # regardless of which solver/precision wrote the file.
+    state_dtype = vals["w"].dtype
     as_dev = lambda x: jnp.asarray(x)
-    return PCGState(**{key: as_dev(val) for key, val in vals.items()})
+    state = PCGState(**{key: as_dev(val) for key, val in vals.items()})
+    return state._replace(
+        k=jnp.asarray(vals["k"], jnp.int32),
+        done=jnp.asarray(bool(vals["done"])),
+        zr=jnp.asarray(vals["zr"], state_dtype),
+        diff=jnp.asarray(vals["diff"], state_dtype),
+        flag=jnp.asarray(vals["flag"], jnp.int32),
+        best=jnp.asarray(vals["best"], state_dtype),
+        stall=jnp.asarray(vals["stall"], jnp.int32),
+    )
+
+
+def load_state(path: str, fingerprint: str,
+               keep_last: int = 2) -> Optional[PCGState]:
+    """Returns the newest trustworthy saved state, or None if no
+    generation exists or every generation is corrupt (a corrupt-only chain
+    warns and starts over rather than crashing the resume). A corrupt or
+    mismatched newest generation falls back to ``path.1``, ``path.2``, …;
+    a fingerprint mismatch with no loadable older generation raises (the
+    checkpoint belongs to a different problem — resuming would silently
+    solve the wrong one)."""
+    mismatch: Optional[ValueError] = None
+    existed = 0
+    for candidate in checkpoint_generations(path, keep_last):
+        if not os.path.exists(candidate):
+            continue
+        existed += 1
+        try:
+            state = _read_state(candidate, fingerprint)
+        except CorruptCheckpointError as e:
+            warnings.warn(
+                f"{e} — falling back to the previous checkpoint generation",
+                RuntimeWarning, stacklevel=2,
+            )
+            continue
+        except ValueError as e:
+            mismatch = mismatch or e
+            continue
+        if candidate != path:
+            warnings.warn(
+                f"resuming from older checkpoint generation {candidate} "
+                f"(newest was corrupt or mismatched)",
+                RuntimeWarning, stacklevel=2,
+            )
+        return state
+    if mismatch is not None:
+        raise mismatch
+    if existed:
+        warnings.warn(
+            f"all {existed} checkpoint generation(s) at {path} are "
+            f"corrupt; starting the solve from iteration zero",
+            RuntimeWarning, stacklevel=2,
+        )
+    return None
 
 
 def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                            chunk: int = 200, dtype=None, scaled=None,
-                           keep_checkpoint: bool = False) -> PCGResult:
+                           keep_checkpoint: bool = False,
+                           keep_last: int = 2,
+                           stagnation_window: int = 0,
+                           watchdog=None,
+                           on_chunk=None) -> PCGResult:
     """Solve with periodic state persistence and automatic resume.
 
     Every ``chunk`` iterations the CG state is written to
-    ``checkpoint_path``; if that file already exists (same problem
-    fingerprint) the solve resumes from it instead of starting over. On
-    convergence the checkpoint is removed unless ``keep_checkpoint``.
+    ``checkpoint_path`` (atomic, CRC-sealed, ``keep_last`` generations —
+    see :func:`save_state`); if a trustworthy checkpoint already exists
+    (same problem fingerprint) the solve resumes from it instead of
+    starting over, falling back to an older generation when the newest is
+    corrupt. On convergence the checkpoint is removed unless
+    ``keep_checkpoint``; a cap-hit or divergence stop (``PCGResult.flag``)
+    keeps it. ``watchdog``/``on_chunk`` are the chunk-boundary resilience
+    hooks documented on :func:`run_chunked`.
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -160,19 +387,22 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
         if use_scaled
         else single_device_ops(problem, a, b, aux)
     )
-    state = load_state(checkpoint_path, fp)
+    state = load_state(checkpoint_path, fp, keep_last=keep_last)
     if state is None:
         state = init_state(ops, rhs)
 
     state = run_chunked(
         state,
-        advance=lambda s: _run_chunk(problem, use_scaled, chunk, a, b, aux, s),
+        advance=lambda s: _run_chunk(problem, use_scaled, chunk,
+                                     stagnation_window, a, b, aux, s),
         to_portable=lambda s: s,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
-        keep_checkpoint=keep_checkpoint,
+        keep_checkpoint=keep_checkpoint, keep_last=keep_last,
+        watchdog=watchdog, on_chunk=on_chunk,
     )
 
     w = state.w * aux if use_scaled else state.w
     return PCGResult(
-        w=w, iterations=state.k, diff=state.diff, residual_dot=state.zr
+        w=w, iterations=state.k, diff=state.diff, residual_dot=state.zr,
+        flag=state.flag,
     )

@@ -35,6 +35,7 @@ from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from poisson_tpu.config import Problem
@@ -47,6 +48,25 @@ from poisson_tpu.ops.stencil import (
 )
 
 _DENOM_TOL = 1e-15  # degenerate-direction guard (stage2:…cpp:414)
+
+# Termination verdicts recorded in PCGState.flag / PCGResult.flag. The
+# reference's loop knows only "converged or budget" — at production scale a
+# solve must also say *why* it stopped (NaN blow-up, Krylov breakdown,
+# stagnation) so the recovery driver (solvers.resilient) can decide between
+# restart, precision escalation, and failing loudly.
+FLAG_NONE = 0        # still running, or a solver that does not track verdicts
+FLAG_CONVERGED = 1   # ‖Δw‖ < δ
+FLAG_BREAKDOWN = 2   # |（Ap, p)| below the degenerate-direction guard
+FLAG_NONFINITE = 3   # NaN/Inf reached the residual or update norm
+FLAG_STAGNATED = 4   # no best-‖Δw‖ improvement for a full stagnation window
+
+FLAG_NAMES = {
+    FLAG_NONE: "running",
+    FLAG_CONVERGED: "converged",
+    FLAG_BREAKDOWN: "breakdown",
+    FLAG_NONFINITE: "nonfinite",
+    FLAG_STAGNATED: "stagnated",
+}
 
 
 class PCGOps(NamedTuple):
@@ -68,14 +88,21 @@ class PCGOps(NamedTuple):
 
 
 class PCGState(NamedTuple):
+    """Loop state. The trailing three fields default so solvers that carry
+    their own state types (the fused pallas paths) can build the portable
+    checkpoint state without tracking them."""
+
     k: jnp.ndarray        # iterations completed (reference's `iter`)
-    done: jnp.ndarray     # converged or degenerate
+    done: jnp.ndarray     # converged, degenerate, or diverged
     w: jnp.ndarray
     r: jnp.ndarray
     z: jnp.ndarray
     p: jnp.ndarray
     zr: jnp.ndarray       # ζ = (z, r)
     diff: jnp.ndarray     # last ‖w(k+1)−w(k)‖
+    flag: jnp.ndarray = np.int32(FLAG_NONE)   # termination verdict
+    best: jnp.ndarray = np.inf                # best ‖Δw‖ seen so far
+    stall: jnp.ndarray = np.int32(0)          # iterations since best improved
 
 
 class PCGResult(NamedTuple):
@@ -83,6 +110,7 @@ class PCGResult(NamedTuple):
     iterations: jnp.ndarray
     diff: jnp.ndarray        # final update norm
     residual_dot: jnp.ndarray  # final ζ = (D⁻¹r, r)
+    flag: jnp.ndarray = np.int32(FLAG_NONE)  # termination verdict (FLAG_*)
 
 
 def _select(pred, new, old):
@@ -103,14 +131,38 @@ def init_state(ops: PCGOps, rhs) -> PCGState:
         done=jnp.asarray(False),
         w=w, r=r, z=z, p=p, zr=zr,
         diff=jnp.asarray(jnp.inf, rhs.dtype),
+        flag=jnp.asarray(FLAG_NONE, jnp.int32),
+        best=jnp.asarray(jnp.inf, rhs.dtype),
+        stall=jnp.zeros((), jnp.int32),
     )
 
 
+def restart_state(ops: PCGOps, rhs, w) -> PCGState:
+    """Fresh CG restart from an existing iterate: r = B − Aw, z = D⁻¹r,
+    p = z. The recovery driver (``solvers.resilient``) uses this to resume
+    from the last good iterate after a divergence — the Krylov history is
+    discarded (it is what went bad), the accumulated solution is kept."""
+    r = rhs - ops.apply_A(ops.exchange(w))
+    z = ops.apply_Dinv(r)
+    zr = ops.dot(z, r)
+    return init_state(ops, rhs)._replace(w=w, r=r, z=z, p=z, zr=zr)
+
+
 def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
-                  h1: float, h2: float):
+                  h1: float, h2: float, stagnation_window: int = 0):
     """One PCG iteration as a pure state→state function — shared by the
     convergence ``while_loop`` (:func:`pcg_loop`) and the fixed-budget
-    diagnostic ``scan`` (``solvers.history``)."""
+    diagnostic ``scan`` (``solvers.history``).
+
+    Every iteration classifies its own outcome into ``flag`` so a failing
+    solve stops at the iteration that went bad instead of burning the rest
+    of its budget on NaNs: a non-finite residual/update norm sets
+    FLAG_NONFINITE, the degenerate-direction break FLAG_BREAKDOWN, and —
+    when ``stagnation_window`` > 0 — ``stagnation_window`` consecutive
+    iterations without a new best ‖Δw‖ set FLAG_STAGNATED. The checks only
+    ever stop iterations that could no longer converge, so converging
+    solves keep their golden iteration counts bit-for-bit.
+    """
 
     def body(s: PCGState) -> PCGState:
         p = ops.exchange(s.p)
@@ -132,26 +184,50 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
         beta = zr_new / jnp.where(s.zr == 0.0, 1.0, s.zr)
         p_new = z_new + beta * p
 
+        # In-loop health classification. NaN/Inf anywhere in the scalars
+        # poisons every later iterate, so stopping is strictly better than
+        # looping to the cap; a converged verdict requires finite scalars
+        # (NaN < δ is False anyway, but be explicit about precedence).
+        nonfinite = ~(jnp.isfinite(diff) & jnp.isfinite(zr_new))
+        improved = diff < s.best
+        best_new = jnp.minimum(s.best, diff)
+        stall_new = jnp.where(improved, 0, s.stall + 1).astype(jnp.int32)
+        if stagnation_window > 0:
+            stagnated = (~converged) & (stall_new >= stagnation_window)
+        else:
+            stagnated = jnp.asarray(False)
+        flag = jnp.where(
+            nonfinite, FLAG_NONFINITE,
+            jnp.where(converged, FLAG_CONVERGED,
+                      jnp.where(stagnated, FLAG_STAGNATED, FLAG_NONE)),
+        ).astype(jnp.int32)
+
         # Degenerate break happens before any update (stage2:…cpp:410-415):
         # keep the old state entirely. Convergence break keeps this
         # iteration's w/r/z updates (p is then irrelevant).
         candidate = PCGState(
             k=s.k + 1,
-            done=degenerate | converged,
+            done=degenerate | converged | nonfinite | stagnated,
             w=w_new, r=r_new, z=z_new, p=p_new,
             zr=zr_new, diff=diff,
+            flag=flag, best=best_new, stall=stall_new,
         )
-        kept = s._replace(k=s.k + 1, done=jnp.asarray(True))
+        kept = s._replace(
+            k=s.k + 1, done=jnp.asarray(True),
+            flag=jnp.asarray(FLAG_BREAKDOWN, jnp.int32),
+        )
         return _select(degenerate, kept, candidate)
 
     return body
 
 
 def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
-             weighted_norm: bool, h1: float, h2: float) -> PCGState:
+             weighted_norm: bool, h1: float, h2: float,
+             stagnation_window: int = 0) -> PCGState:
     """Run the PCG while_loop to convergence; backend-agnostic."""
     body = make_pcg_body(
-        ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2
+        ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
+        stagnation_window=stagnation_window,
     )
 
     def cond(s: PCGState):
@@ -260,7 +336,8 @@ def _solve(problem: Problem, scaled: bool, a, b, rhs, aux) -> PCGResult:
         h1=problem.h1, h2=problem.h2,
     )
     w = s.w * aux if scaled else s.w
-    return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr)
+    return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr,
+                     flag=s.flag)
 
 
 def resolve_dtype(dtype) -> str:

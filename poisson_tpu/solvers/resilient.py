@@ -1,0 +1,319 @@
+"""Self-healing single-device solve: divergence recovery with precision
+escalation.
+
+The reference pipeline assumes every iteration succeeds; the in-loop
+classification in ``solvers.pcg`` only *detects* that one didn't. This
+module closes the loop. The solve runs in chunks (the checkpoint
+machinery's chunk driver), and after every chunk the host inspects the
+termination verdict:
+
+- **converged** — done; the checkpoint (if any) is cleaned up;
+- **non-finite / breakdown / stagnation** — the Krylov history is what
+  went bad, so it is discarded and CG is restarted from the last good
+  iterate (``solvers.pcg.restart_state``): the accumulated solution ``w``
+  is kept, r/z/p/ζ are re-derived from it. CG restarted from a good
+  iterate converges from where it left off;
+- **repeated failure at the same precision** — the precision itself is
+  the likely culprit (the fp32 viability of this problem class is
+  conditional on symmetric scaling; bf16 is never more than a gamble), so
+  the state is escalated one rung up the bf16 → f32 → f64 ladder and
+  restarted there;
+- **restart budget exhausted** — :class:`DivergenceError`, carrying full
+  diagnostics, rather than an endless restart loop.
+
+Faults are injected between chunks via the same ``on_chunk`` hook the
+checkpointed solvers take (``testing.faults``), which is how the recovery
+path is exercised on CPU in tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers.checkpoint import (
+    _fingerprint,
+    _run_chunk,
+    checkpoint_generations,
+    save_state,
+)
+from poisson_tpu.solvers.pcg import (
+    FLAG_CONVERGED,
+    FLAG_NAMES,
+    FLAG_NONE,
+    FLAG_NONFINITE,
+    PCGResult,
+    host_setup,
+    init_state,
+    restart_state,
+    resolve_dtype,
+    resolve_scaled,
+    scaled_single_device_ops,
+    single_device_ops,
+)
+
+# Escalation ladder, low to high. A resilient solve enters at its
+# requested dtype and only ever moves up.
+_LADDER = ("bfloat16", "float32", "float64")
+
+
+class DivergenceError(RuntimeError):
+    """The solve kept failing after every recovery the policy allows.
+    ``diagnostics`` records the restart/escalation history for the
+    post-mortem."""
+
+    def __init__(self, message: str, diagnostics: Optional[dict] = None):
+        super().__init__(message)
+        self.diagnostics = diagnostics or {}
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryPolicy:
+    """What the resilient driver may do about a failing solve.
+
+    max_restarts: total recovery attempts (restarts + escalations) before
+        giving up with DivergenceError.
+    escalate: allow moving up the precision ladder after a repeated
+        failure at the same precision (f64 requires jax_enable_x64; an
+        unavailable rung is skipped).
+    stagnation_window: in-loop stagnation detection — iterations without
+        a new best ‖Δw‖ before the loop stops with FLAG_STAGNATED
+        (0 disables; see ``solvers.pcg.make_pcg_body``).
+    """
+
+    max_restarts: int = 3
+    escalate: bool = True
+    stagnation_window: int = 200
+
+
+def _rungs_above(dtype_name: str) -> list:
+    """Ladder rungs strictly above ``dtype_name`` that this runtime can
+    actually execute (f64 needs x64)."""
+    if dtype_name not in _LADDER:
+        return []
+    rungs = list(_LADDER[_LADDER.index(dtype_name) + 1:])
+    if not jax.config.jax_enable_x64:
+        rungs = [r for r in rungs if r != "float64"]
+    return rungs
+
+
+def _build(problem: Problem, dtype_name: str, scaled: bool):
+    a, b, rhs, aux = host_setup(problem, dtype_name, scaled)
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    return a, b, rhs, aux, ops
+
+
+def _load_any_rung(path: str, problem: Problem, dtype_name: str,
+                   scaled: bool, keep_last: int):
+    """Resume across an earlier run's escalation: accept the NEWEST
+    loadable generation whose fingerprint matches the requested precision
+    or any higher rung (a previous resilient run may have escalated before
+    it was interrupted — its escalated checkpoint outranks the stale
+    pre-escalation generation behind it, so generations are walked outermost
+    and rungs innermost)."""
+    from poisson_tpu.solvers.checkpoint import (
+        CorruptCheckpointError,
+        _read_state,
+        checkpoint_generations,
+    )
+
+    rungs = [dtype_name] + _rungs_above(dtype_name)
+    fps = {dn: _fingerprint(problem, dn, scaled) for dn in rungs}
+    mismatch = None
+    existed = 0
+    for candidate in checkpoint_generations(path, keep_last):
+        if not os.path.exists(candidate):
+            continue
+        existed += 1
+        for dn in rungs:
+            try:
+                state = _read_state(candidate, fps[dn])
+            except CorruptCheckpointError as e:
+                warnings.warn(
+                    f"{e} — falling back to the previous checkpoint "
+                    f"generation", RuntimeWarning, stacklevel=2,
+                )
+                break   # unreadable regardless of fingerprint
+            except ValueError as e:
+                mismatch = mismatch or e
+                continue
+            if candidate != path:
+                warnings.warn(
+                    f"resuming from older checkpoint generation "
+                    f"{candidate} (newest was corrupt or mismatched)",
+                    RuntimeWarning, stacklevel=2,
+                )
+            return state, dn
+    if mismatch is not None:
+        raise mismatch
+    if existed:
+        warnings.warn(
+            f"all {existed} checkpoint generation(s) at {path} are "
+            f"corrupt; starting the solve from iteration zero",
+            RuntimeWarning, stacklevel=2,
+        )
+    return None, dtype_name
+
+
+def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
+                        chunk: int = 100,
+                        policy: Optional[RecoveryPolicy] = None,
+                        checkpoint_path: Optional[str] = None,
+                        keep_last: int = 2,
+                        keep_checkpoint: bool = False,
+                        watchdog=None,
+                        on_chunk=None) -> PCGResult:
+    """Single-device solve that survives NaN blow-ups, Krylov breakdowns
+    and stagnation by restarting from the last good iterate, escalating
+    precision when a restart alone does not help.
+
+    Converging solves run the exact same iterations as ``pcg_solve`` —
+    recovery only engages on states that could no longer converge. With
+    ``checkpoint_path`` the solve additionally persists hardened
+    checkpoints every ``chunk`` iterations (and resumes from them, even
+    ones written at an escalated precision by an interrupted earlier run).
+    ``watchdog``/``on_chunk`` are the chunk-boundary hooks documented on
+    ``solvers.checkpoint.run_chunked``.
+    """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    policy = policy or RecoveryPolicy()
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+
+    if checkpoint_path:
+        saved, dtype_name = _load_any_rung(
+            checkpoint_path, problem, dtype_name, use_scaled, keep_last
+        )
+    else:
+        saved = None
+
+    a, b, rhs, aux, ops = _build(problem, dtype_name, use_scaled)
+    state = saved if saved is not None else init_state(ops, rhs)
+
+    cap = problem.iteration_cap
+    restarts = 0
+    restarts_at_dtype = 0
+    history = []            # (iteration, verdict, dtype, action)
+    last_good = (state.w, int(state.k))   # device-resident (immutable)
+    fp = _fingerprint(problem, dtype_name, use_scaled)
+    chunks_done = 0
+
+    def diagnostics(flag: int) -> dict:
+        return {
+            "problem": f"{problem.M}x{problem.N}",
+            "verdict": FLAG_NAMES.get(flag, str(flag)),
+            "iteration": int(state.k),
+            "dtype": dtype_name,
+            "restarts": restarts,
+            "history": list(history),
+            "diff": float(state.diff),
+            "residual_dot": float(state.zr),
+        }
+
+    if watchdog is not None:
+        watchdog.start()
+    try:
+        while True:
+            state = _run_chunk(problem, use_scaled, chunk,
+                               policy.stagnation_window, a, b, aux, state)
+            jax.block_until_ready(state)
+            chunks_done += 1
+            if watchdog is not None:
+                watchdog.beat(k=int(state.k), diff=float(state.diff),
+                              dtype=dtype_name, restarts=restarts)
+            flag = int(state.flag)
+
+            if flag == FLAG_CONVERGED:
+                break
+            if flag == FLAG_NONE:
+                # The in-loop checks watch the reduced scalars (diff, ζ);
+                # a NaN confined to the solution grid w never enters a
+                # reduction, so validate the would-be snapshot — as a
+                # device-side reduction (one scalar crosses to the host,
+                # not the grid) — before trusting it as "last good".
+                if not bool(jnp.isfinite(state.w).all()):
+                    flag = FLAG_NONFINITE
+            if flag == FLAG_NONE:
+                # Healthy chunk boundary: snapshot, persist, inject.
+                # jax arrays are immutable, so holding the reference is a
+                # free device-resident snapshot; it only crosses to the
+                # host if a restart or checkpoint write needs it.
+                last_good = (state.w, int(state.k))
+                if checkpoint_path:
+                    save_state(checkpoint_path, state, fp,
+                               keep_last=keep_last)
+                if on_chunk is not None:
+                    replacement = on_chunk(state, chunks_done)
+                    if replacement is not None:
+                        state = replacement
+                if int(state.k) >= cap:
+                    break  # budget exhausted, unconverged: like pcg_solve
+                continue
+
+            # flag is a failure verdict: recover or give up.
+            restarts += 1
+            restarts_at_dtype += 1
+            if restarts > policy.max_restarts:
+                diag = diagnostics(flag)
+                raise DivergenceError(
+                    f"solve failed ({FLAG_NAMES.get(flag, flag)} at "
+                    f"iteration {int(state.k)}, dtype {dtype_name}) and "
+                    f"the recovery budget ({policy.max_restarts} restarts) "
+                    f"is exhausted",
+                    diagnostics=diag,
+                )
+            escalated = False
+            if policy.escalate and restarts_at_dtype > 1:
+                rungs = _rungs_above(dtype_name)
+                if rungs:
+                    dtype_name = rungs[0]
+                    a, b, rhs, aux, ops = _build(
+                        problem, dtype_name, use_scaled
+                    )
+                    fp = _fingerprint(problem, dtype_name, use_scaled)
+                    restarts_at_dtype = 0
+                    escalated = True
+            action = (f"escalate->{dtype_name}" if escalated
+                      else f"restart@{dtype_name}")
+            history.append((int(state.k), FLAG_NAMES.get(flag, str(flag)),
+                            action))
+            warnings.warn(
+                f"solve {FLAG_NAMES.get(flag, str(flag))} at iteration "
+                f"{int(state.k)}; {action} from last good iterate "
+                f"(iteration {last_good[1]})",
+                RuntimeWarning, stacklevel=2,
+            )
+            w_good = jnp.asarray(last_good[0], jnp.dtype(dtype_name))
+            state = restart_state(ops, rhs, w_good)._replace(
+                k=jnp.asarray(last_good[1], jnp.int32)
+            )
+    except KeyboardInterrupt:
+        if watchdog is not None:
+            watchdog.raise_if_fired()   # timeout → typed SolveTimeout
+        raise
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+
+    if (checkpoint_path and int(state.flag) == FLAG_CONVERGED
+            and not keep_checkpoint):
+        for candidate in checkpoint_generations(checkpoint_path, keep_last):
+            if os.path.exists(candidate):
+                os.remove(candidate)
+
+    w = state.w * aux if use_scaled else state.w
+    return PCGResult(
+        w=w, iterations=state.k, diff=state.diff, residual_dot=state.zr,
+        flag=state.flag,
+    )

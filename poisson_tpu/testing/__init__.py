@@ -1,0 +1,17 @@
+"""Test-support subsystem: fault injection for the resilience layer."""
+
+from poisson_tpu.testing.faults import (
+    FaultPlan,
+    PreemptionInjected,
+    chunk_hook,
+    corrupt_file,
+    inject_nan,
+)
+
+__all__ = [
+    "FaultPlan",
+    "PreemptionInjected",
+    "chunk_hook",
+    "corrupt_file",
+    "inject_nan",
+]

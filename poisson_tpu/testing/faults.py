@@ -1,0 +1,120 @@
+"""Fault injection for the resilience layer.
+
+Every failure mode the resilience subsystem claims to survive must be
+reproducible on demand, on CPU, in the test suite — otherwise the recovery
+code is exactly the kind of untested-until-3am path this framework exists
+to avoid. This module provides the three injection primitives:
+
+- **NaN blow-up**: :func:`inject_nan` pokes a NaN into a solver buffer at
+  a chunk boundary; the in-loop divergence detection (``solvers.pcg``)
+  must flag it and the recovery driver (``solvers.resilient``) must
+  restart from the last good iterate.
+- **Checkpoint corruption**: :func:`corrupt_file` bit-flips, truncates, or
+  zeroes a checkpoint on disk; the hardened loader
+  (``solvers.checkpoint.load_state``) must detect the damage via CRC and
+  fall back to the previous generation.
+- **Preemption**: :func:`chunk_hook` raises :class:`PreemptionInjected`
+  between chunks, simulating a killed host; a rerun must resume from the
+  persisted checkpoint and reproduce the uninterrupted result exactly.
+
+The CLI exposes these as ``--fault-nan-at``, ``--fault-preempt-after`` and
+``--fault-corrupt-checkpoint`` so operators can fire-drill a deployment's
+recovery story end to end, not just the library's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class PreemptionInjected(RuntimeError):
+    """Raised by the chunk hook to simulate a preempted/killed host at a
+    chunk boundary (after the checkpoint for that chunk was persisted)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Declarative description of the faults to inject into one solve.
+
+    nan_at_iteration: poke a NaN into ``nan_buffer`` at the first chunk
+        boundary whose iteration count reaches this value (None: never).
+    nan_buffer: which state array to poison ('r', 'w', 'p' or 'z').
+    preempt_after_chunks: raise PreemptionInjected once this many chunks
+        have completed (None: never). The checkpoint of the final chunk is
+        already on disk when the "kill" lands — the honest simulation of a
+        preemption signal between chunks.
+    """
+
+    nan_at_iteration: Optional[int] = None
+    nan_buffer: str = "r"
+    preempt_after_chunks: Optional[int] = None
+
+    def __post_init__(self):
+        if self.nan_buffer not in ("r", "w", "p", "z"):
+            raise ValueError(
+                f"nan_buffer must be one of r/w/p/z, got {self.nan_buffer!r}"
+            )
+
+
+def inject_nan(state, buffer: str = "r"):
+    """Return ``state`` with a NaN written into one interior cell of the
+    named buffer — the minimal, realistic poison (a single flipped value,
+    as a bad DMA or a soft error would produce), which one stencil
+    application then spreads exactly like the real failure mode."""
+    arr = np.array(np.asarray(getattr(state, buffer)))
+    arr[tuple(d // 2 for d in arr.shape)] = np.nan
+    return state._replace(**{buffer: jnp.asarray(arr)})
+
+
+def corrupt_file(path: str, mode: str = "flip") -> None:
+    """Damage a file on disk the way real storage does.
+
+    'flip': XOR one byte in the middle (silent bit rot — the case only the
+    CRC can catch); 'truncate': cut the file to 60% (interrupted write of
+    a non-atomic writer, or a torn copy); 'zero': zero out a 256-byte
+    block (sparse-file hole / bad sector readback).
+    """
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot corrupt empty file {path}")
+    with open(path, "r+b") as f:
+        if mode == "flip":
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        elif mode == "truncate":
+            f.truncate(max(1, (size * 3) // 5))
+        elif mode == "zero":
+            f.seek(max(0, size // 2 - 128))
+            f.write(b"\x00" * min(256, size))
+        else:
+            raise ValueError(
+                f"mode must be flip/truncate/zero, got {mode!r}"
+            )
+
+
+def chunk_hook(plan: FaultPlan):
+    """Compile a :class:`FaultPlan` into the ``on_chunk(state,
+    chunks_done)`` callback consumed by ``run_chunked`` and the resilient
+    driver. Each fault fires at most once per hook instance."""
+    fired = {"nan": False}
+
+    def hook(state, chunks_done: int):
+        if (plan.preempt_after_chunks is not None
+                and chunks_done >= plan.preempt_after_chunks):
+            raise PreemptionInjected(
+                f"injected preemption after chunk {chunks_done}"
+            )
+        if (plan.nan_at_iteration is not None and not fired["nan"]
+                and int(state.k) >= plan.nan_at_iteration):
+            fired["nan"] = True
+            return inject_nan(state, plan.nan_buffer)
+        return None
+
+    return hook

@@ -1,0 +1,31 @@
+"""Version portability shims for the narrow slice of JAX API this
+framework depends on.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (where its
+replication check is spelled ``check_rep``) to ``jax.shard_map`` (where it
+is spelled ``check_vma``). The parallel stack is written against the new
+spelling; this shim keeps it running on JAX versions that only ship the
+experimental entry point — a resilience concern in its own right: the
+sharded solvers (and their checkpoint/recovery paths) must not be the
+first thing to break when the environment pins an older JAX.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` when available, else the experimental API with
+    ``check_vma`` mapped onto its older ``check_rep`` spelling."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

@@ -102,6 +102,9 @@ class SolveReport:
     devices: int
     mesh: Optional[tuple[int, int]] = None
     l2_error: Optional[float] = None
+    # Termination verdict name (solvers.pcg.FLAG_NAMES) when the solver
+    # stopped for a reason other than convergence; None otherwise.
+    stopped: Optional[str] = None
 
     def json_line(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -121,6 +124,9 @@ class SolveReport:
                 else ""
             ),
         ]
+        if self.stopped is not None:
+            rows.append(f"  WARNING: solve stopped without converging "
+                        f"({self.stopped})")
         return "\n".join(rows)
 
 
@@ -135,6 +141,17 @@ def solve_report(
     l2_error: Optional[float] = None,
 ) -> SolveReport:
     iters = int(result.iterations)
+    # Verdict-tracking solvers (PCGResult.flag) surface abnormal stops in
+    # the report; converged/untracked results stay quiet.
+    stopped = None
+    flag = getattr(result, "flag", None)
+    if flag is not None:
+        from poisson_tpu.solvers.pcg import FLAG_CONVERGED, FLAG_NAMES, \
+            FLAG_NONE
+
+        flag = int(flag)
+        if flag not in (FLAG_NONE, FLAG_CONVERGED):
+            stopped = FLAG_NAMES.get(flag, str(flag))
     return SolveReport(
         M=problem.M,
         N=problem.N,
@@ -147,4 +164,5 @@ def solve_report(
         devices=devices,
         mesh=mesh,
         l2_error=l2_error,
+        stopped=stopped,
     )

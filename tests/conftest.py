@@ -41,6 +41,11 @@ def pytest_configure(config):
         "xslow: minutes-long solve (largest grids); skipped unless "
         "RUN_XSLOW=1 or selected with -m xslow",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection suite for the resilience layer "
+        "(CPU-fast; runs in tier-1, selectable with -m faults)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

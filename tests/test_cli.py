@@ -56,6 +56,14 @@ def test_bad_mesh_spec_rejected():
         build_parser().parse_args(["40", "40", "--mesh", "banana"])
 
 
+def test_ca_sharded_bad_bm_exits_cleanly():
+    """A --bm that is not a positive multiple of 8 must exit like every
+    other flag-validation path, not surface ca_shard_spec's ValueError as
+    a traceback (round-5 advice)."""
+    with pytest.raises(SystemExit, match="positive multiple of 8"):
+        main(["40", "40", "--backend", "pallas-ca-sharded", "--bm", "13"])
+
+
 def test_sharded_checkpoint_cli(capsys, tmp_path):
     ck = str(tmp_path / "ck.npz")
     assert main(["40", "40", "--backend", "sharded", "--mesh", "2x4",
@@ -107,8 +115,8 @@ def test_converged_solve_skips_final_checkpoint_write(tmp_path, monkeypatch):
     real_save = ckpt.save_state
     monkeypatch.setattr(
         ckpt, "save_state",
-        lambda path, state, fp: (writes.append(int(state.k)),
-                                 real_save(path, state, fp)),
+        lambda path, state, fp, **kw: (writes.append(int(state.k)),
+                                       real_save(path, state, fp, **kw)),
     )
     p = Problem(M=40, N=40)
     got = ckpt.pcg_solve_checkpointed(p, str(tmp_path / "ck.npz"), chunk=7)

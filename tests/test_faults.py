@@ -1,0 +1,502 @@
+"""Fault-injection suite for the resilience layer (tier-1, CPU-only).
+
+Every recovery path the framework claims — in-loop divergence detection,
+restart-from-last-good-iterate, precision escalation, hardened checkpoint
+fallback, preemption resume — is exercised here against injected faults
+(``poisson_tpu.testing.faults``), on small grids so the whole suite stays
+fast enough for tier-1.
+"""
+
+import glob
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers import checkpoint as ckpt
+from poisson_tpu.solvers.pcg import (
+    FLAG_CONVERGED,
+    FLAG_NONFINITE,
+    FLAG_STAGNATED,
+    pcg_solve,
+    resolve_dtype,
+    resolve_scaled,
+)
+from poisson_tpu.solvers.resilient import (
+    DivergenceError,
+    RecoveryPolicy,
+    pcg_solve_resilient,
+)
+from poisson_tpu.testing.faults import (
+    FaultPlan,
+    PreemptionInjected,
+    chunk_hook,
+    corrupt_file,
+    inject_nan,
+)
+
+pytestmark = pytest.mark.faults
+
+
+def _fp(problem, dtype=None):
+    d = resolve_dtype(dtype)
+    return ckpt._fingerprint(problem, d, resolve_scaled(None, d))
+
+
+# ---------------------------------------------------------------------------
+# In-loop detection
+# ---------------------------------------------------------------------------
+
+
+def test_nonfinite_detection_stops_and_keeps_last_good(tmp_path):
+    """An injected NaN is flagged within the next chunk instead of burning
+    the rest of the iteration budget, and the poisoned state is never
+    written over the last good checkpoint."""
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    hook = chunk_hook(FaultPlan(nan_at_iteration=15))
+    res = ckpt.pcg_solve_checkpointed(p, path, chunk=10, on_chunk=hook)
+    assert int(res.flag) == FLAG_NONFINITE
+    # Detection fires on the first post-injection iteration (k=21), not at
+    # the iteration cap.
+    assert int(res.iterations) <= 25
+    state = ckpt.load_state(path, _fp(p))
+    assert int(state.k) == 20                       # the pre-fault boundary
+    assert np.isfinite(np.asarray(state.w)).all()
+    assert np.isfinite(np.asarray(state.r)).all()
+
+
+def test_breakdown_detection_on_unreachable_tolerance(tmp_path):
+    """An unreachable tolerance drives r → 0 until the degenerate-
+    direction guard fires: the solve stops with FLAG_BREAKDOWN long
+    before the (M-1)(N-1) cap, and the non-converged stop keeps its
+    checkpoint for diagnosis (pre-hardening, done-means-converged cleanup
+    would have deleted it)."""
+    from poisson_tpu.solvers.pcg import FLAG_BREAKDOWN
+
+    p = Problem(M=40, N=40, delta=1e-300)
+    path = str(tmp_path / "ck.npz")
+    res = ckpt.pcg_solve_checkpointed(p, path, chunk=50,
+                                      stagnation_window=30)
+    assert int(res.flag) == FLAG_BREAKDOWN
+    assert int(res.iterations) < 100            # cap is (M-1)(N-1) = 1521
+    assert os.path.exists(path)
+
+
+def test_stagnation_detection_unit():
+    """The stall counter at the make_pcg_body level: a synthetic backend
+    whose update norm never improves stops with FLAG_STAGNATED exactly one
+    iteration after the window closes (the real problem's diff improves
+    every iteration until breakdown, so the mechanism needs a fake)."""
+    import jax.numpy as jnp
+
+    from poisson_tpu.solvers.pcg import PCGOps, pcg_loop
+
+    ops = PCGOps(
+        apply_A=lambda p: p,
+        apply_Dinv=lambda r: r,
+        dot=lambda u, v: jnp.asarray(1.0),      # no breakdown, no progress
+        sqnorm=lambda u: jnp.asarray(1.0),      # constant ||dw||
+        exchange=lambda p: p,
+    )
+    s = pcg_loop(ops, jnp.ones((4, 4)), delta=0.5, max_iter=1000,
+                 weighted_norm=False, h1=1.0, h2=1.0, stagnation_window=25)
+    assert int(s.flag) == FLAG_STAGNATED
+    assert int(s.k) == 26                       # window + the first best
+    # And the same loop without the window runs to its budget.
+    s2 = pcg_loop(ops, jnp.ones((4, 4)), delta=0.5, max_iter=100,
+                  weighted_norm=False, h1=1.0, h2=1.0)
+    assert int(s2.k) == 100 and int(s2.flag) == 0
+
+
+def test_converging_solves_keep_their_iteration_counts():
+    """Detection must be observation-only for healthy solves: the golden
+    40x40 count survives with stagnation detection armed."""
+    p = Problem(M=40, N=40)
+    ref = pcg_solve(p)
+    res = pcg_solve_resilient(
+        p, chunk=10, policy=RecoveryPolicy(stagnation_window=200),
+    )
+    assert int(res.iterations) == int(ref.iterations) == 50
+    assert int(res.flag) == FLAG_CONVERGED
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(ref.w), rtol=0, atol=1e-12
+    )
+
+
+# ---------------------------------------------------------------------------
+# Recovery (acceptance: injected mid-run NaN recovers and converges to the
+# same tolerance as an uninjected run)
+# ---------------------------------------------------------------------------
+
+
+def test_nan_injection_recovers_and_converges():
+    p = Problem(M=40, N=40)
+    ref = pcg_solve(p)
+    hook = chunk_hook(FaultPlan(nan_at_iteration=15))
+    with pytest.warns(RuntimeWarning, match="nonfinite.*restart"):
+        res = pcg_solve_resilient(p, chunk=10, on_chunk=hook)
+    assert int(res.flag) == FLAG_CONVERGED
+    assert float(res.diff) < p.delta                # same tolerance met
+    # Same answer to within the convergence tolerance (the recovered path
+    # runs different iterates, so bit-equality is not expected).
+    err = np.abs(np.asarray(res.w) - np.asarray(ref.w)).max()
+    assert err < 50 * p.delta
+    # Recovery restarted from iteration 20's iterate, not from scratch.
+    assert int(res.iterations) > int(ref.iterations)
+
+
+def test_nan_injection_into_solution_buffer_recovers():
+    """The injected buffer need not be the residual: a poisoned solution
+    grid w is equally recovered (the restart re-derives r from w_good)."""
+    p = Problem(M=40, N=40)
+    hook = chunk_hook(FaultPlan(nan_at_iteration=15, nan_buffer="w"))
+    with pytest.warns(RuntimeWarning, match="restart"):
+        res = pcg_solve_resilient(p, chunk=10, on_chunk=hook)
+    assert int(res.flag) == FLAG_CONVERGED
+    assert float(res.diff) < p.delta
+
+
+def test_escalation_ladder_reaches_f64():
+    """Two failures at the same precision escalate f32 -> f64 (restart
+    alone first, then the ladder)."""
+    p = Problem(M=40, N=40)
+    count = {"n": 0}
+
+    def hook(state, chunks_done):
+        if count["n"] < 2 and int(state.k) >= 10:
+            count["n"] += 1
+            return inject_nan(state)
+        return None
+
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        res = pcg_solve_resilient(p, dtype="float32", chunk=10,
+                                  on_chunk=hook)
+    messages = [str(w.message) for w in ws]
+    assert any("restart@float32" in m for m in messages)
+    assert any("escalate->float64" in m for m in messages)
+    assert int(res.flag) == FLAG_CONVERGED
+    assert np.asarray(res.w).dtype == np.float64
+
+
+def test_recovery_budget_exhaustion_raises_with_diagnostics():
+    p = Problem(M=40, N=40)
+
+    def hook(state, chunks_done):   # poison every boundary: unrecoverable
+        return inject_nan(state)
+
+    with pytest.raises(DivergenceError) as exc_info, \
+            warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pcg_solve_resilient(
+            p, chunk=10, on_chunk=hook,
+            policy=RecoveryPolicy(max_restarts=2, escalate=False),
+        )
+    diag = exc_info.value.diagnostics
+    assert diag["verdict"] == "nonfinite"
+    assert diag["restarts"] == 3        # the raising attempt included
+    assert len(diag["history"]) == 2    # the two restarts that were granted
+    assert diag["problem"] == "40x40"
+
+
+# ---------------------------------------------------------------------------
+# Preemption (acceptance: a chunked solve killed between chunks resumes
+# from checkpoint and matches the uninterrupted final residual)
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_resume_matches_uninterrupted(tmp_path):
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    hook = chunk_hook(FaultPlan(preempt_after_chunks=2))
+    with pytest.raises(PreemptionInjected):
+        ckpt.pcg_solve_checkpointed(p, path, chunk=10, on_chunk=hook)
+    assert os.path.exists(path)         # the kill landed between chunks
+
+    uninterrupted = ckpt.pcg_solve_checkpointed(
+        p, str(tmp_path / "ref.npz"), chunk=10
+    )
+    resumed = ckpt.pcg_solve_checkpointed(p, path, chunk=10)
+    assert int(resumed.iterations) == int(uninterrupted.iterations)
+    assert float(resumed.diff) == float(uninterrupted.diff)
+    np.testing.assert_array_equal(                  # exact resume
+        np.asarray(resumed.w), np.asarray(uninterrupted.w)
+    )
+    assert not os.path.exists(path)     # converged run cleaned up
+
+
+def test_sharded_preemption_resume_matches(tmp_path):
+    """The same kill-between-chunks drill on the distributed solver (the
+    virtual 8-device CPU mesh)."""
+    from poisson_tpu.parallel import (
+        make_solver_mesh,
+        pcg_solve_sharded_checkpointed,
+    )
+
+    p = Problem(M=40, N=40)
+    mesh = make_solver_mesh()
+    path = str(tmp_path / "ck.npz")
+    hook = chunk_hook(FaultPlan(preempt_after_chunks=2))
+    with pytest.raises(PreemptionInjected):
+        pcg_solve_sharded_checkpointed(p, mesh, path, chunk=10,
+                                       on_chunk=hook)
+    assert os.path.exists(path)
+    uninterrupted = pcg_solve_sharded_checkpointed(
+        p, mesh, str(tmp_path / "ref.npz"), chunk=10
+    )
+    resumed = pcg_solve_sharded_checkpointed(p, mesh, path, chunk=10)
+    assert int(resumed.iterations) == int(uninterrupted.iterations)
+    assert float(resumed.diff) == float(uninterrupted.diff)
+    np.testing.assert_array_equal(
+        np.asarray(resumed.w), np.asarray(uninterrupted.w)
+    )
+
+
+def test_resilient_resumes_across_preemption(tmp_path):
+    """Preempt a checkpointed *resilient* solve, then finish it in a fresh
+    call — the production recovery workflow end to end."""
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    hook = chunk_hook(FaultPlan(preempt_after_chunks=2))
+    with pytest.raises(PreemptionInjected):
+        pcg_solve_resilient(p, chunk=10, checkpoint_path=path,
+                            on_chunk=hook)
+    ref = pcg_solve(p)
+    res = pcg_solve_resilient(p, chunk=10, checkpoint_path=path)
+    assert int(res.flag) == FLAG_CONVERGED
+    assert int(res.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(ref.w), rtol=0, atol=1e-12
+    )
+
+
+# ---------------------------------------------------------------------------
+# Hardened checkpoints (acceptance: a corrupted latest checkpoint triggers
+# fallback to the previous one)
+# ---------------------------------------------------------------------------
+
+
+def _two_generations(tmp_path, p):
+    """Run 3 chunks of 10 with retention: newest generation at k=30,
+    previous at k=20."""
+    path = str(tmp_path / "ck.npz")
+    ckpt.pcg_solve_checkpointed(p.with_(max_iter=30), path, chunk=10,
+                                keep_checkpoint=True)
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    return path
+
+
+@pytest.mark.parametrize("mode", ["flip", "truncate", "zero"])
+def test_corrupt_latest_falls_back_to_previous(tmp_path, mode):
+    p = Problem(M=40, N=40)
+    path = _two_generations(tmp_path, p)
+    corrupt_file(path, mode)
+    with pytest.warns(RuntimeWarning, match="previous checkpoint"):
+        state = ckpt.load_state(path, _fp(p))
+    assert int(state.k) == 20           # the previous generation
+    # And the fallback state actually finishes the solve correctly.
+    ref = pcg_solve(p)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        res = ckpt.pcg_solve_checkpointed(p, path, chunk=10)
+    assert int(res.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(res.w), np.asarray(ref.w), rtol=0, atol=1e-12
+    )
+
+
+def test_all_generations_corrupt_starts_over(tmp_path):
+    p = Problem(M=40, N=40)
+    path = _two_generations(tmp_path, p)
+    corrupt_file(path, "truncate")
+    corrupt_file(path + ".1", "zero")
+    with pytest.warns(RuntimeWarning, match="starting the solve from"):
+        state = ckpt.load_state(path, _fp(p))
+    assert state is None
+
+
+def test_crc_catches_silent_payload_corruption(tmp_path):
+    """A bit-rot pattern that keeps the npz structurally valid — an array
+    value changed, the stored CRC untouched — is caught by the integrity
+    check, the case no structural parser can see."""
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    ckpt.pcg_solve_checkpointed(p.with_(max_iter=10), path, chunk=10,
+                                keep_checkpoint=True, keep_last=1)
+    with np.load(path) as d:
+        data = {k: d[k] for k in d.files}
+    data["w"] = data["w"].copy()
+    data["w"][5, 5] += 1.0              # silent flip, CRC left stale
+    np.savez(path, **data)
+    with pytest.warns(RuntimeWarning, match="integrity"):
+        assert ckpt.load_state(path, _fp(p), keep_last=1) is None
+
+
+def test_atomic_write_leaves_no_partials_on_midwrite_kill(tmp_path,
+                                                          monkeypatch):
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    ckpt.pcg_solve_checkpointed(p.with_(max_iter=10), path, chunk=10,
+                                keep_checkpoint=True)
+    good = ckpt.load_state(path, _fp(p))
+
+    def dying_savez(file, **arrays):
+        with open(file, "wb") as f:
+            f.write(b"partial garbage")
+        raise OSError("simulated kill mid-write")
+
+    monkeypatch.setattr(ckpt.np, "savez", dying_savez)
+    with pytest.raises(OSError, match="simulated kill"):
+        ckpt.save_state(path, good, _fp(p))
+    monkeypatch.undo()
+    # No temp droppings, and the original checkpoint is intact.
+    assert glob.glob(str(tmp_path / "*.tmp*")) == []
+    reread = ckpt.load_state(path, _fp(p))
+    assert int(reread.k) == int(good.k)
+    np.testing.assert_array_equal(np.asarray(reread.w), np.asarray(good.w))
+
+
+def test_fingerprint_mismatch_reported_clearly(tmp_path):
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    ckpt.pcg_solve_checkpointed(p.with_(max_iter=10), path, chunk=10,
+                                keep_checkpoint=True)
+    wrong = _fp(p.with_(delta=1e-4))
+    with pytest.raises(ValueError) as exc_info:
+        ckpt.load_state(path, wrong)
+    msg = str(exc_info.value)
+    # The report names the file and shows both fingerprints.
+    assert "different problem" in msg
+    assert "saved:" in msg and "requested:" in msg
+
+
+def test_mismatched_newest_falls_back_to_matching_previous(tmp_path):
+    """Retention also covers the mixed case: the newest generation belongs
+    to another problem but an older one matches — resume from it (with a
+    warning) instead of refusing outright."""
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    ckpt.pcg_solve_checkpointed(p.with_(max_iter=10), path, chunk=10,
+                                keep_checkpoint=True)       # fp(p) at path
+    # A newer generation written for a *different* problem rotates p's
+    # file to .1 (same arrays; only the fingerprint matters here).
+    state_a = ckpt.load_state(path, _fp(p))
+    ckpt.save_state(path, state_a, _fp(p.with_(delta=1e-4)))
+    with pytest.warns(RuntimeWarning, match="older checkpoint generation"):
+        state = ckpt.load_state(path, _fp(p))
+    assert state is not None and int(state.k) == 10
+
+
+def test_escalated_checkpoint_outranks_stale_lower_precision(tmp_path):
+    """Resume across an earlier run's escalation: the newest generation
+    (written at an escalated precision) must win over the stale
+    pre-escalation generation behind it, even though the latter matches
+    the requested precision's fingerprint (review finding: the rung loop
+    must be inside the generation walk, not outside)."""
+    from poisson_tpu.solvers.resilient import _load_any_rung
+
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    scaled = resolve_scaled(None, "float32")    # fixed across the ladder
+
+    def fp(dn):
+        return ckpt._fingerprint(p, dn, scaled)
+
+    # Era 1: an f32 run checkpoints at k=10 …
+    ckpt.pcg_solve_checkpointed(p.with_(max_iter=10), path, chunk=10,
+                                dtype="float32", scaled=scaled,
+                                keep_checkpoint=True)
+    # … then (simulated) escalates to f64 and checkpoints k=50, rotating
+    # the f32 generation to .1.
+    state32 = ckpt.load_state(path, fp("float32"))
+    state64 = state32._replace(
+        w=np.asarray(state32.w, np.float64),
+        r=np.asarray(state32.r, np.float64),
+        z=np.asarray(state32.z, np.float64),
+        p=np.asarray(state32.p, np.float64),
+        k=np.int32(50),
+    )
+    ckpt.save_state(path, state64, fp("float64"))
+
+    state, dn = _load_any_rung(path, p, "float32", scaled, keep_last=2)
+    assert dn == "float64"
+    assert int(state.k) == 50                   # the escalated progress
+    # And with the newest generation corrupted, the stale f32 one is still
+    # a valid fallback.
+    corrupt_file(path, "flip")
+    with pytest.warns(RuntimeWarning, match="previous checkpoint"):
+        state, dn = _load_any_rung(path, p, "float32", scaled, keep_last=2)
+    assert dn == "float32" and int(state.k) == 10
+
+
+def test_legacy_checkpoint_without_crc_or_flags_loads(tmp_path):
+    """Pre-hardening files (no crc32, no verdict fields) still resume —
+    the fleet's existing checkpoints must not be orphaned by an upgrade."""
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "ck.npz")
+    ckpt.pcg_solve_checkpointed(p.with_(max_iter=10), path, chunk=10,
+                                keep_checkpoint=True)
+    with np.load(path) as d:
+        data = {k: d[k] for k in d.files}
+    legacy = {k: v for k, v in data.items()
+              if k not in ("crc32", "flag", "best", "stall")}
+    np.savez(path, **legacy)
+    state = ckpt.load_state(path, _fp(p))
+    assert int(state.k) == 10
+    assert int(state.flag) == 0         # defaults backfilled
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+
+
+def test_cli_resilient_nan_injection(capsys):
+    from poisson_tpu.cli import main
+
+    assert main(["40", "40", "--backend", "xla", "--resilient",
+                 "--chunk", "10", "--fault-nan-at", "15", "--json"]) == 0
+    out = capsys.readouterr().out
+    assert '"stopped": null' in out
+
+
+def test_cli_preempt_resume_roundtrip(tmp_path, capsys):
+    from poisson_tpu.cli import main
+
+    ck = str(tmp_path / "ck.npz")
+    rc = main(["40", "40", "--backend", "xla", "--checkpoint", ck,
+               "--chunk", "10", "--fault-preempt-after", "2", "--json"])
+    assert rc == 75                     # EX_TEMPFAIL: rerun to resume
+    assert os.path.exists(ck)
+    capsys.readouterr()
+    assert main(["40", "40", "--backend", "xla", "--checkpoint", ck,
+                 "--chunk", "10", "--json"]) == 0
+
+
+def test_cli_corrupt_checkpoint_fallback(tmp_path, capsys):
+    from poisson_tpu.cli import main
+
+    ck = str(tmp_path / "ck.npz")
+    assert main(["40", "40", "--backend", "xla", "--checkpoint", ck,
+                 "--chunk", "10", "--fault-preempt-after", "2",
+                 "--json"]) == 75
+    capsys.readouterr()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        assert main(["40", "40", "--backend", "xla", "--checkpoint", ck,
+                     "--chunk", "10", "--fault-corrupt-checkpoint", "flip",
+                     "--json"]) == 0
+
+
+def test_cli_fault_flags_need_a_chunked_driver():
+    from poisson_tpu.cli import main
+
+    with pytest.raises(SystemExit, match="chunk boundaries"):
+        main(["40", "40", "--backend", "xla", "--fault-nan-at", "5"])
+    with pytest.raises(SystemExit, match="retention"):
+        main(["40", "40", "--backend", "xla", "--keep-last", "3"])
+    with pytest.raises(SystemExit, match="native"):
+        main(["40", "40", "--backend", "native", "--resilient"])

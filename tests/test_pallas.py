@@ -225,12 +225,17 @@ def test_parallel_grid_matches_sequential():
 def test_megacore_predicate():
     """The caveat warning fires exactly on megacore parts: two TensorCores
     fused behind one device (v4, v5p) — not on single-core lite parts, not
-    on per-core-device v2/v3, not off-TPU."""
+    on per-core-device v2/v3, not off-TPU. Real libtpu device_kind strings
+    include the bare 'TPU v4'/'TPU v5' spellings (v5p has been reported as
+    'TPU v5', with no 'p') and the lite parts' 'TPU v5 lite'/'TPU v5e'."""
     from poisson_tpu.ops.pallas_cg import _is_megacore
     assert _is_megacore("tpu", "TPU v4")
     assert _is_megacore("tpu", "TPU v5p")
+    assert _is_megacore("tpu", "TPU v5")       # how libtpu reports v5p
     assert not _is_megacore("tpu", "TPU v5 lite")
     assert not _is_megacore("tpu", "TPU v5e")
+    assert not _is_megacore("tpu", "TPU v5litepod-8")
+    assert not _is_megacore("tpu", "TPU v6e")
     assert not _is_megacore("tpu", "TPU v3")
     assert not _is_megacore("cpu", "cpu")
 

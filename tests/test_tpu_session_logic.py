@@ -252,7 +252,8 @@ class TestSummarizerBandwidthCheck:
     def test_sane_and_suspect_verdicts(self):
         m = self._mod()
         sane = {"grid": [800, 1200], "solve_seconds": 0.0397,
-                "iterations": 989, "backend": "xla", "platform": "tpu"}
+                "iterations": 989, "backend": "xla", "platform": "tpu",
+                "device_kind": "TPU v5 lite"}
         budget, verdict = m._passes_budget(sane)
         assert float(budget) == pytest.approx(8.6, abs=0.1)
         assert verdict == " sane"
@@ -260,16 +261,33 @@ class TestSummarizerBandwidthCheck:
         # fused kernels — admits ~4.5 passes where the kernels move 14.7.
         r2 = {"grid": [800, 1200], "solve_seconds": 0.0211,
               "iterations": 989, "backend": "pallas_fused",
-              "platform": "tpu"}
+              "platform": "tpu", "device_kind": "TPU v5e"}
         budget, verdict = m._passes_budget(r2)
         assert float(budget) < 5.0
         assert "SUSPECT" in verdict
+
+    def test_verdict_gated_on_v5e(self):
+        """The 0.82 TB/s ceiling is a v5e number; a session captured on
+        another TPU generation prints the passes figure with no verdict
+        instead of mislabeling every row (round-5 advice)."""
+        m = self._mod()
+        base = {"grid": [800, 1200], "solve_seconds": 0.0397,
+                "iterations": 989, "backend": "xla", "platform": "tpu"}
+        for kind in ("TPU v4", "TPU v5p", "TPU v5", "TPU v6e", None):
+            budget, verdict = m._passes_budget({**base,
+                                                "device_kind": kind})
+            assert budget != "—"      # the number still prints
+            assert verdict == "", kind
+        # device_kind may also arrive from the enclosing record.
+        _, verdict = m._passes_budget(base, "TPU v5 lite")
+        assert verdict == " sane"
 
     def test_incomplete_records_stay_quiet(self):
         m = self._mod()
         assert m._passes_budget({}) == ("—", "")
         cpu = {"grid": [40, 40], "solve_seconds": 0.1, "iterations": 50,
-               "backend": "xla", "platform": "cpu"}
+               "backend": "xla", "platform": "cpu",
+               "device_kind": "TPU v5e"}
         _, verdict = m._passes_budget(cpu)
         assert verdict == ""
 

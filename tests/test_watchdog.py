@@ -1,0 +1,190 @@
+"""Watchdog heartbeat/timeout and multihost retry-with-backoff (tier-1,
+CPU-only; part of the fault-injection suite)."""
+
+import json
+import os
+import time
+import warnings
+
+import pytest
+
+from poisson_tpu.parallel.watchdog import Watchdog
+
+pytestmark = pytest.mark.faults
+
+
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def test_heartbeat_file_written_atomically(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    wd = Watchdog(heartbeat_path=hb)
+    with wd:
+        wd.beat(k=42, diff=1e-3)
+        payload = json.loads(open(hb).read())
+    assert payload["k"] == 42
+    assert payload["beats"] == 1
+    assert payload["pid"] == os.getpid()
+    # No tmp droppings from the atomic replace.
+    assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
+
+
+def test_regular_beats_keep_the_monitor_quiet():
+    fired = []
+    wd = Watchdog(timeout=0.3, poll_interval=0.05,
+                  on_timeout=fired.append)
+    with wd:
+        for _ in range(8):
+            time.sleep(0.05)
+            wd.beat()
+    assert not wd.fired
+    assert fired == []
+
+
+def test_stall_fires_timeout_with_diagnostics(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    fired = []
+    wd = Watchdog(heartbeat_path=hb, timeout=0.15, poll_interval=0.03,
+                  on_timeout=fired.append)
+    with wd:
+        wd.beat(k=7, diff=0.5)
+        assert _wait_for(lambda: wd.fired)     # no further beats: stall
+    diag = fired[0]
+    assert diag["timeout_seconds"] == 0.15
+    assert diag["elapsed_seconds"] > 0.15
+    assert diag["last_progress"] == {"k": 7, "diff": 0.5}
+    # Diagnostics file lands next to the heartbeat for the post-mortem.
+    stalled = json.loads(open(hb + ".stalled.json").read())
+    assert stalled["last_progress"]["k"] == 7
+
+
+def test_timeout_fires_once_and_stop_joins():
+    fired = []
+    wd = Watchdog(timeout=0.1, poll_interval=0.02, on_timeout=fired.append)
+    wd.start()
+    assert _wait_for(lambda: wd.fired)
+    time.sleep(0.15)                            # would double-fire if buggy
+    wd.stop()
+    assert len(fired) == 1
+
+
+def test_raise_if_fired_converts_to_solve_timeout():
+    """The chunked drivers turn a watchdog interrupt into the typed
+    SolveTimeout (diagnostics attached); an unfired watchdog is a no-op."""
+    from poisson_tpu.parallel.watchdog import SolveTimeout
+
+    wd = Watchdog(timeout=0.1, poll_interval=0.02, on_timeout=lambda d: None)
+    wd.raise_if_fired()                         # not fired: no-op
+    with wd:
+        assert _wait_for(lambda: wd.fired)
+    with pytest.raises(SolveTimeout) as exc_info:
+        wd.raise_if_fired()
+    assert exc_info.value.diagnostics["timeout_seconds"] == 0.1
+
+
+def test_watchdog_wired_into_chunked_solver(tmp_path):
+    from poisson_tpu.config import Problem
+    from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
+
+    hb = str(tmp_path / "hb.json")
+    fired = []
+    wd = Watchdog(heartbeat_path=hb, timeout=300.0,
+                  on_timeout=fired.append)
+    res = pcg_solve_checkpointed(
+        Problem(M=40, N=40), str(tmp_path / "ck.npz"), chunk=10,
+        watchdog=wd,
+    )
+    assert int(res.iterations) == 50
+    assert fired == []
+    payload = json.loads(open(hb).read())
+    assert payload["beats"] >= 5                # one per chunk
+    assert payload["k"] == 50
+    # run_chunked stopped the watchdog: the monitor thread is gone.
+    assert wd._thread is None
+
+
+class TestMultihostRetry:
+    """initialize_multihost retries transient coordinator failures with
+    backoff, degrades to single-host when env-driven, and still fails
+    loudly for explicit clusters (monkeypatched init — no real cluster)."""
+
+    @pytest.fixture
+    def multihost(self, monkeypatch):
+        import poisson_tpu.parallel.multihost as mh
+
+        monkeypatch.setattr(mh, "_initialized", False)
+        return mh
+
+    def test_transient_failure_retries_then_succeeds(self, multihost,
+                                                     monkeypatch):
+        import jax
+
+        calls = {"n": 0}
+
+        def flaky_init(**kw):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("connection refused by coordinator")
+
+        naps = []
+        monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+        with pytest.warns(RuntimeWarning, match="retry"):
+            idx = multihost.initialize_multihost(
+                backoff_seconds=0.1, sleep=naps.append
+            )
+        assert idx == 0
+        assert calls["n"] == 3
+        assert naps == [0.1, 0.2]               # exponential backoff
+
+    def test_env_driven_exhaustion_degrades_to_single_host(self, multihost,
+                                                           monkeypatch):
+        import jax
+
+        def always_down(**kw):
+            raise RuntimeError("deadline exceeded connecting to coordinator")
+
+        monkeypatch.setattr(jax.distributed, "initialize", always_down)
+        with pytest.warns(RuntimeWarning, match="single-host"):
+            idx = multihost.initialize_multihost(
+                max_retries=2, backoff_seconds=0.01, sleep=lambda s: None
+            )
+        assert idx == 0                         # usable, local-only world
+
+    def test_explicit_cluster_exhaustion_raises(self, multihost,
+                                                monkeypatch):
+        import jax
+
+        def always_down(**kw):
+            raise RuntimeError("connection timed out")
+
+        monkeypatch.setattr(jax.distributed, "initialize", always_down)
+        with pytest.raises(RuntimeError, match="timed out"), \
+                warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            multihost.initialize_multihost(
+                coordinator="10.0.0.1:1234", num_processes=4, process_id=1,
+                max_retries=1, backoff_seconds=0.01, sleep=lambda s: None,
+            )
+
+    def test_config_errors_do_not_retry(self, multihost, monkeypatch):
+        import jax
+
+        calls = {"n": 0}
+
+        def bad_config(**kw):
+            calls["n"] += 1
+            raise RuntimeError(
+                "jax.distributed.initialize must be called before any "
+                "backend is initialized"
+            )
+
+        monkeypatch.setattr(jax.distributed, "initialize", bad_config)
+        with pytest.raises(RuntimeError, match="first JAX call"):
+            multihost.initialize_multihost()
+        assert calls["n"] == 1                  # no retry on ordering bugs
