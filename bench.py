@@ -205,6 +205,15 @@ def main() -> int:
     downgraded = _acquire_backend()
     _adopt_layout_decision()
 
+    # Unified telemetry, env-driven (argv is the grid contract):
+    # POISSON_TPU_TRACE_DIR / POISSON_TPU_METRICS_OUT /
+    # POISSON_TPU_STREAM_EVERY. After the backend probe on purpose — the
+    # poisson_tpu import initializes jax, which must not happen before
+    # the probe pins the platform.
+    from poisson_tpu import obs
+
+    obs.configure_from_env()
+
     import jax
 
     # The env pin above covers a fresh import; if jax was already imported
@@ -347,46 +356,56 @@ def main() -> int:
     # mis-iterates is demoted to the next in the chain, xla last.
     golden = GOLDEN_ITERS.get((problem.M, problem.N))
     result = None
-    while True:
-        t0 = time.perf_counter()
-        try:
-            result = run()
-            fence(result)
-            # fp32 reduction order drifts the count by O(0.1%) at the
-            # largest grids; 1% still catches a broken kernel.
-            if backend != "xla" and golden is not None and not (
-                abs(int(result.iterations) - golden)
-                <= max(5, golden // 100)
-            ):
-                raise RuntimeError(
-                    f"suspect iterations {int(result.iterations)}"
-                )
-            break
-        except Exception as e:
-            if backend == "xla":
-                raise
-            if os.environ.get("BENCH_BACKEND") == backend:
-                # A forced backend that constructs but fails warm-up (a
-                # kernel raise or a golden-iteration mismatch) must fail
-                # the run, not quietly produce an artifact for a backend
-                # the caller explicitly did not ask for (ADVICE r3).
-                print(f"bench: forced backend {backend!r} failed warm-up "
-                      f"({e!r:.500})", file=sys.stderr)
-                raise
-            print(f"bench: {backend} warm-up failed ({e!r:.500})",
-                  file=sys.stderr)
-            backend = "xla"
-            run = xla_run
-            while fallbacks:
-                name = fallbacks.pop(0)
-                try:
-                    run = make_tpu_run(name)
-                    backend = name
-                    break
-                except Exception as e2:
-                    print(f"bench: {name} backend unavailable "
-                          f"({e2!r:.500})", file=sys.stderr)
-    compile_and_first = time.perf_counter() - t0
+    warmup_span = obs.span("bench.warmup_compile", fence=False,
+                           grid=f"{problem.M}x{problem.N}")
+    warmup_span.__enter__()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            try:
+                result = run()
+                fence(result)
+                # fp32 reduction order drifts the count by O(0.1%) at the
+                # largest grids; 1% still catches a broken kernel.
+                if backend != "xla" and golden is not None and not (
+                    abs(int(result.iterations) - golden)
+                    <= max(5, golden // 100)
+                ):
+                    raise RuntimeError(
+                        f"suspect iterations {int(result.iterations)}"
+                    )
+                break
+            except Exception as e:
+                if backend == "xla":
+                    raise
+                if os.environ.get("BENCH_BACKEND") == backend:
+                    # A forced backend that constructs but fails warm-up (a
+                    # kernel raise or a golden-iteration mismatch) must fail
+                    # the run, not quietly produce an artifact for a backend
+                    # the caller explicitly did not ask for (ADVICE r3).
+                    print(f"bench: forced backend {backend!r} failed "
+                          f"warm-up ({e!r:.500})", file=sys.stderr)
+                    raise
+                print(f"bench: {backend} warm-up failed ({e!r:.500})",
+                      file=sys.stderr)
+                backend = "xla"
+                run = xla_run
+                while fallbacks:
+                    name = fallbacks.pop(0)
+                    try:
+                        run = make_tpu_run(name)
+                        backend = name
+                        break
+                    except Exception as e2:
+                        print(f"bench: {name} backend unavailable "
+                              f"({e2!r:.500})", file=sys.stderr)
+        compile_and_first = time.perf_counter() - t0
+    finally:
+        # Close the span on the failure path too: a warm-up that dies is
+        # exactly the run the forensics timeline must still show.
+        warmup_span.__exit__(None, None, None)
+    obs.inc("time.compile_seconds", compile_and_first)
+    obs.event("bench.backend", backend=backend, platform=platform)
 
     gated = len(devices) == 1  # sharded path has no gate (overlap is
     # negligible there: the mesh is busy across the whole solve)
@@ -403,9 +422,13 @@ def main() -> int:
         fence(res.iterations)
         return time.perf_counter() - t0
 
-    t_lo = min(timed_chain(K_LO) for _ in range(3))
-    t_hi = min(timed_chain(K_HI) for _ in range(3))
+    with obs.span("bench.timed_chains", fence=False,
+                  k_lo=K_LO, k_hi=K_HI) as timed_span:
+        t_lo = min(timed_chain(K_LO) for _ in range(3))
+        t_hi = min(timed_chain(K_HI) for _ in range(3))
     best = (t_hi - t_lo) / (K_HI - K_LO)
+    if getattr(timed_span, "seconds", None) is not None:
+        obs.inc("time.execute_seconds", timed_span.seconds)
 
     iters = int(result.iterations)
     value = mlups(problem, iters, best)
@@ -489,6 +512,11 @@ def main() -> int:
                 "best": good.get("best"),
             }
 
+    obs.gauge("bench.mlups", record["value"])
+    obs.gauge("bench.vs_baseline", record["vs_baseline"])
+    obs.event("bench.record", **record["detail"],
+              mlups=record["value"])
+    obs.finalize()
     print(json.dumps(record))
     return 0
 

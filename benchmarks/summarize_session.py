@@ -6,7 +6,17 @@ backend, MLUPS, iterations vs golden, L2 — plus the layout and
 backend-chain decisions. The table is the working draft for the
 post-session BENCH.md update; the jsonl stays the ground truth.
 
+``--telemetry DIR`` switches to solve-forensics mode: renders a report
+from a unified-telemetry directory (``poisson_tpu.obs`` — what
+``python -m poisson_tpu … --trace-dir DIR`` writes): phases and their
+durations, restarts/escalations, checkpoint activity, watchdog
+beats/stalls, stop verdicts, MLUPS, and the streamed convergence curve
+summary — the post-mortem the round-5 wedged tunnel never had. Reads
+the files directly (stdlib only): importing the framework would
+initialize jax, which a post-session forensics pass must never risk.
+
 Usage: python benchmarks/summarize_session.py [session.jsonl] [--since ISO]
+       python benchmarks/summarize_session.py --telemetry DIR
 """
 
 from __future__ import annotations
@@ -111,13 +121,141 @@ def _row_from(step: str, e: dict) -> list[str] | None:
             _fmt(iters), _fmt(l2), budget + verdict, at]
 
 
+# -- telemetry forensics mode (poisson_tpu.obs trace directories) -------
+
+
+def _read_jsonl(path: pathlib.Path) -> list[dict]:
+    records = []
+    try:
+        lines = path.read_text().splitlines()
+    except OSError:
+        return records
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            continue        # torn tail line of a killed process
+    return records
+
+
+def _load_telemetry(tdir: pathlib.Path):
+    """(events, counters, stream_by_rank) from an obs trace directory —
+    local readers on the documented schema; see the module docstring for
+    why this does not import poisson_tpu.obs."""
+    events, counters, stream = [], {}, {}
+    for p in sorted(tdir.glob("events-rank*.jsonl")):
+        events.extend(_read_jsonl(p))
+    events.sort(key=lambda r: r.get("at_unix", 0.0))
+    for p in sorted(tdir.glob("metrics-rank*.json")):
+        try:
+            snap = json.loads(p.read_text())
+        except (OSError, ValueError):
+            continue
+        for name, val in (snap.get("counters") or {}).items():
+            try:
+                counters[name] = counters.get(name, 0) + val
+            except TypeError:
+                continue
+    for p in sorted(tdir.glob("stream-rank*.jsonl")):
+        rank = p.stem.replace("stream-rank", "")
+        stream[rank] = _read_jsonl(p)
+    return events, counters, stream
+
+
+def telemetry_report(tdir: pathlib.Path) -> int:
+    if not tdir.is_dir():
+        print(f"no telemetry directory at {tdir}", file=sys.stderr)
+        return 1
+    events, counters, stream = _load_telemetry(tdir)
+    traces = sorted(tdir.glob("trace-rank*.trace.json"))
+    print(f"# Solve forensics: {tdir}")
+    print(f"\n{len(events)} events, {len(traces)} rank trace(s)"
+          + (f" — open in https://ui.perfetto.dev" if traces else ""))
+
+    # Phases: span_end records carry the fenced duration.
+    spans: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("kind") == "span_end" and "seconds" in e:
+            spans.setdefault(e["name"], []).append(e["seconds"])
+    if spans:
+        print("\n## Phases\n")
+        print("| span | count | total s | mean s |")
+        print("|---|---|---|---|")
+        for name, secs in sorted(spans.items(),
+                                 key=lambda kv: -sum(kv[1])):
+            print(f"| {name} | {len(secs)} | {sum(secs):.4f} "
+                  f"| {sum(secs) / len(secs):.4f} |")
+
+    # The headline: what the solve reported about itself.
+    reports = [e for e in events
+               if e.get("kind") == "event" and e.get("name") == "solve.report"]
+    for r in reports:
+        stopped = r.get("stopped")
+        print(f"\n## Solve {r.get('M')}x{r.get('N')} "
+              f"[{r.get('backend', '?')} / {r.get('dtype', '?')}"
+              + (f" / {r.get('device_kind')}" if r.get("device_kind")
+                 else "") + "]\n")
+        print(f"- iterations: {r.get('iterations')}  "
+              f"verdict: {stopped if stopped else 'converged'}")
+        print(f"- solve: {r.get('solve_seconds', 0):.4f} s   "
+              f"compile: {r.get('compile_seconds', 0):.2f} s   "
+              f"throughput: {r.get('mlups', 0):.0f} MLUPS")
+        if r.get("restarts"):
+            print(f"- RECOVERED: {r['restarts']} restart(s): "
+                  f"{r.get('recovery')}")
+
+    # Incidents: everything that is not routine liveness.
+    incidents = [e for e in events if e.get("kind") == "event" and e.get(
+        "name") in ("resilient.restart", "watchdog.stall",
+                    "checkpoint.crc_failure", "checkpoint.corrupt",
+                    "checkpoint.generation_fallback", "multihost.init_retry",
+                    "multihost.degraded")]
+    if incidents:
+        print("\n## Incidents\n")
+        for e in incidents:
+            detail = {k: v for k, v in e.items()
+                      if k not in ("at_unix", "at_mono", "kind", "name",
+                                   "rank")}
+            print(f"- rank {e.get('rank', '?')} `{e['name']}`: "
+                  f"{json.dumps(detail, default=str)[:200]}")
+
+    if counters:
+        print("\n## Counters (all ranks summed)\n")
+        print("| counter | value |")
+        print("|---|---|")
+        for name in sorted(counters):
+            val = counters[name]
+            shown = f"{val:.4f}" if isinstance(val, float) else str(val)
+            print(f"| {name} | {shown} |")
+
+    if stream:
+        print("\n## Streamed convergence\n")
+        for rank, samples in sorted(stream.items()):
+            if not samples:
+                continue
+            first, last = samples[0], samples[-1]
+            print(f"- rank {rank}: {len(samples)} samples, "
+                  f"iter {first.get('k')} ||dw|| {first.get('diff'):.3e} "
+                  f"→ iter {last.get('k')} ||dw|| {last.get('diff'):.3e}")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("log", nargs="?", default=str(
         _ROOT / "benchmarks" / "results" / "session.jsonl"))
     ap.add_argument("--since", default=None, metavar="ISO_UTC",
                     help="only entries at/after this UTC timestamp")
+    ap.add_argument("--telemetry", default=None, metavar="DIR",
+                    help="render a solve-forensics report from a unified-"
+                         "telemetry directory (--trace-dir output) instead "
+                         "of a session log")
     args = ap.parse_args()
+    if args.telemetry:
+        return telemetry_report(pathlib.Path(args.telemetry))
     path = pathlib.Path(args.log)
     if not path.exists():
         print(f"no session log at {path}", file=sys.stderr)

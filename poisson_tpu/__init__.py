@@ -16,6 +16,11 @@ the fictitious-domain method — see SURVEY.md):
                  ``ppermute`` halo exchange, ``psum`` reductions — the TPU-native
                  equivalent of the reference's MPI decomposition (§2.3-2.4).
 - ``utils``    — instrumentation, timing, reporting (reference layer 7, §5).
+- ``obs``      — unified telemetry: fenced spans (Chrome/Perfetto traces +
+                 JSONL event logs, per-rank mergeable), always-on counters,
+                 and opt-in streamed convergence out of the fused loop —
+                 the production observability layer the reference's five
+                 hand-placed ``MPI_Wtime`` accumulators only hinted at.
 
 The single-device solver is the stage0/stage1 equivalent; the sharded solver is
 the stage2/3/4 equivalent; Pallas kernels play the role of stage4's CUDA kernels.

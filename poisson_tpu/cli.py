@@ -50,8 +50,16 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m poisson_tpu",
         description="Fictitious-domain Poisson PCG solve (TPU-native framework).",
     )
-    p.add_argument("M", type=int, help="grid cells in x (nodes: M+1)")
-    p.add_argument("N", type=int, help="grid cells in y (nodes: N+1)")
+    p.add_argument("M", type=int, nargs="?", default=None,
+                   help="grid cells in x (nodes: M+1)")
+    p.add_argument("N", type=int, nargs="?", default=None,
+                   help="grid cells in y (nodes: N+1)")
+    # Flag aliases for the grid (automation-friendly invocations pass
+    # every parameter as a flag); exactly one of the two forms per axis.
+    p.add_argument("--M", type=int, default=None, dest="M_opt",
+                   metavar="M", help="grid cells in x (same as positional M)")
+    p.add_argument("--N", type=int, default=None, dest="N_opt",
+                   metavar="N", help="grid cells in y (same as positional N)")
     p.add_argument("--delta", type=float, default=1e-6,
                    help="convergence threshold on ||w(k+1)-w(k)|| (default 1e-6)")
     p.add_argument("--max-iter", type=int, default=None,
@@ -106,8 +114,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "iterations and resume from it (every JAX backend; "
                         "fp32 checkpoints are portable across backends and "
                         "mesh shapes)")
-    p.add_argument("--chunk", type=int, default=200,
-                   help="iterations between checkpoints (default 200)")
+    p.add_argument("--chunk", type=int, default=None,
+                   help="iterations between checkpoints (default 200; "
+                        "with --fault-nan-at K, min(200, K) so the "
+                        "injection boundary lands before a fast solve "
+                        "converges)")
     r = p.add_argument_group(
         "resilience",
         "divergence recovery, hardened checkpoints, watchdog, fault "
@@ -154,6 +165,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fault injection: damage the newest checkpoint "
                         "generation on disk before solving (exercises the "
                         "CRC fallback)")
+    o = p.add_argument_group(
+        "observability",
+        "unified telemetry: spans, counters, streamed convergence "
+        "(README 'Observability')",
+    )
+    o.add_argument("--trace-dir", metavar="DIR", default=None,
+                   help="write telemetry here: a Perfetto-loadable "
+                        "trace-rank{R}.trace.json, an events-rank{R}.jsonl "
+                        "event log, metrics-rank{R}.json counters, and "
+                        "(with --stream-every) the convergence curve")
+    o.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="write the counters/gauges snapshot to this single "
+                        "JSON file at exit (restarts, checkpoint writes, "
+                        "watchdog beats, iterations by verdict, ...)")
+    o.add_argument("--stream-every", type=int, default=0, metavar="K",
+                   help="stream (iteration, ||dw||) out of the fused loop "
+                        "every K iterations — live progress + recorded "
+                        "curve (XLA backends; 0 = off, the default: the "
+                        "compiled program is byte-identical)")
     p.add_argument("--save-solution", metavar="PATH", default=None,
                    help="write the solution grid to PATH (.npy) — the "
                         "reference never persisted its solution")
@@ -188,6 +218,7 @@ def _run_native(args, problem: Problem):
     report = solve_report(
         problem, result, best, compile_seconds=0.0, dtype="float64",
         devices=0, l2_error=l2_error_host(problem, result.w),
+        backend="native",
     )
     return report, timer, result.w
 
@@ -197,6 +228,10 @@ def _pick_backend(args) -> str:
 
     if args.backend != "auto":
         return args.backend
+    if args.resilient:
+        # --resilient drives the single-device xla recovery driver; auto
+        # must not outsmart it onto a backend that would then reject it.
+        return "xla"
     devices = jax.devices()
     tpu = devices[0].platform == "tpu"
     # --checkpoint needs no special-casing: every JAX backend auto-pick can
@@ -242,7 +277,7 @@ def _resilience_kit(args):
 
 
 def _run_jax(args, problem: Problem, backend: str, watchdog=None,
-             on_chunk=None):
+             on_chunk=None, stream_every: int = 0):
     import jax
 
     from poisson_tpu.analysis import l2_error_host
@@ -436,6 +471,7 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
         run = lambda: pcg_solve_resilient(
             problem, dtype=args.dtype, chunk=args.chunk, policy=policy,
             checkpoint_path=args.checkpoint, keep_last=args.keep_last,
+            stream_every=stream_every,
             watchdog=watchdog, on_chunk=on_chunk,
         )
         n_dev = 1
@@ -446,25 +482,53 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
             problem, args.checkpoint, chunk=args.chunk, dtype=args.dtype,
             keep_last=args.keep_last,
             stagnation_window=args.stagnation_window or 0,
+            stream_every=stream_every,
             watchdog=watchdog, on_chunk=on_chunk,
         )
         n_dev = 1
     else:
         from poisson_tpu.solvers.pcg import pcg_solve
 
-        run = lambda: pcg_solve(problem, dtype=args.dtype)
+        run = lambda: pcg_solve(problem, dtype=args.dtype,
+                                stream_every=stream_every)
         n_dev = 1
+
+    from poisson_tpu import obs
 
     with timer.phase("compile_and_first_solve"):
         result = run()
         fence(result)
-    best = None
-    for _ in range(max(1, args.repeat)):
-        t0 = time.perf_counter()
-        result = run()
-        fence(result.iterations)
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
+    # Recovery provenance can land on any run (an injected fault fires
+    # once per hook, usually during warm-up); keep the richest record so
+    # the report's recovered-line survives the timed re-runs.
+    recovered = (getattr(result, "restarts", None),
+                 getattr(result, "recovery_history", ()))
+    warm_flag = getattr(result, "flag", None)
+    failed_warmup = False
+    if warm_flag is not None:
+        from poisson_tpu.solvers.pcg import FLAG_CONVERGED, FLAG_NONE
+
+        failed_warmup = int(warm_flag) not in (FLAG_NONE, FLAG_CONVERGED)
+    if failed_warmup:
+        # The solve stopped with a failure verdict. Re-running it for
+        # timing would MASK that: a checkpointed re-run resumes from the
+        # last good generation and may converge, overwriting the verdict
+        # and timing only the residual iterations (inflated MLUPS).
+        # Report the failed run as what it is.
+        best = timer.times["compile_and_first_solve"]
+    else:
+        best = None
+        with obs.span("timed_solves", fence=False,
+                      repeat=max(1, args.repeat)):
+            for _ in range(max(1, args.repeat)):
+                t0 = time.perf_counter()
+                result = run()
+                fence(result.iterations)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+    if recovered[0] and not getattr(result, "restarts", None):
+        result = result._replace(restarts=recovered[0],
+                                 recovery_history=recovered[1])
 
     if args.profile:
         with jax.profiler.trace(args.profile):
@@ -483,6 +547,8 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
         compile_seconds=timer.times["compile_and_first_solve"] - best,
         dtype=dtype_name, devices=n_dev, mesh=mesh_shape,
         l2_error=l2_error_host(problem, result.w),
+        backend=backend,
+        device_kind=getattr(devices[0], "device_kind", None),
     )
     return report, timer, np.asarray(result.w)
 
@@ -536,11 +602,42 @@ def _categories_table(problem: Problem, dtype, iters: int) -> list[str]:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # Reconcile the positional and flag grid forms: exactly one per axis.
+    for axis in ("M", "N"):
+        pos, opt = getattr(args, axis), getattr(args, f"{axis}_opt")
+        if pos is not None and opt is not None:
+            raise SystemExit(f"give {axis} either positionally or as "
+                             f"--{axis}, not both")
+        if pos is None and opt is None:
+            raise SystemExit(f"missing grid size {axis} (positional or "
+                             f"--{axis})")
+        setattr(args, axis, pos if pos is not None else opt)
     # After parse_args so --help and argv errors stay jax-import-free; see
     # utils.platform for why the env var needs re-asserting (config beats
     # env — the round-2 driver post-mortem).
     honor_jax_platforms_env()
     problem = _problem(args)
+    if args.chunk is None:
+        # The NaN drill injects at the first chunk BOUNDARY at/after K; a
+        # solve that converges inside chunk one would never reach it, so
+        # the default chunk shrinks to make the drill actually fire. An
+        # explicit --chunk is always honored (chunking never changes the
+        # iterate sequence, only where the boundaries land).
+        args.chunk = (min(200, max(1, args.fault_nan_at))
+                      if args.fault_nan_at is not None else 200)
+    elif args.chunk < 1:
+        raise SystemExit(f"--chunk must be >= 1, got {args.chunk}")
+    if args.stream_every < 0:
+        raise SystemExit(f"--stream-every must be >= 0, "
+                         f"got {args.stream_every}")
+    from poisson_tpu import obs
+
+    if args.trace_dir or args.metrics_out or args.stream_every:
+        obs.configure(
+            trace_dir=args.trace_dir, metrics_path=args.metrics_out,
+            stream_every=args.stream_every,
+            stream_live=sys.stderr.isatty() and not args.json,
+        )
     if args.categories and args.json:
         raise SystemExit("--categories produces a table; drop --json")
     if args.checkpoint and args.backend == "native":
@@ -572,6 +669,9 @@ def main(argv=None) -> int:
         jax.config.update("jax_enable_x64", True)
 
     if args.backend == "native":
+        if args.stream_every:
+            raise SystemExit("--stream-every streams from the fused JAX "
+                             "loop; not available with --backend native")
         if args.profile:
             raise SystemExit("--profile captures a JAX device trace; "
                              "not available with --backend native")
@@ -646,6 +746,12 @@ def main(argv=None) -> int:
                 "drivers; use --resilient, or --checkpoint with "
                 f"--backend xla or sharded (resolved backend: {backend})"
             )
+        if args.stream_every and backend != "xla":
+            raise SystemExit(
+                "--stream-every streams (k, ||dw||) from the fused XLA "
+                "while_loop; use --backend xla (resolved backend: "
+                f"{backend})"
+            )
         if args.stagnation_window is not None and not hookable:
             raise SystemExit(
                 "--stagnation-window needs an in-loop-detecting driver; "
@@ -679,7 +785,8 @@ def main(argv=None) -> int:
         watchdog, on_chunk = _resilience_kit(args)
         try:
             report, timer, w = _run_jax(args, problem, backend,
-                                        watchdog=watchdog, on_chunk=on_chunk)
+                                        watchdog=watchdog, on_chunk=on_chunk,
+                                        stream_every=args.stream_every)
         except KeyboardInterrupt:
             # The chunked drivers convert a watchdog interrupt into
             # SolveTimeout; an interrupt that still arrives here raw (e.g.
@@ -687,6 +794,7 @@ def main(argv=None) -> int:
             if watchdog is not None and watchdog.fired:
                 print("watchdog timeout: solve aborted (diagnostics next "
                       "to the heartbeat file)", file=sys.stderr)
+                obs.finalize()
                 return 124
             raise
         except Exception as e:
@@ -694,6 +802,7 @@ def main(argv=None) -> int:
 
             if isinstance(e, SolveTimeout):
                 print(f"{e}", file=sys.stderr)
+                obs.finalize()
                 return 124
             if on_chunk is not None:
                 from poisson_tpu.testing.faults import PreemptionInjected
@@ -701,11 +810,20 @@ def main(argv=None) -> int:
                 if isinstance(e, PreemptionInjected):
                     print(f"{e}; checkpoint retained at {args.checkpoint}"
                           if args.checkpoint else str(e), file=sys.stderr)
+                    obs.finalize()
                     return 75   # EX_TEMPFAIL: rerun to resume
             raise
 
     if args.save_solution:
         np.save(args.save_solution, np.asarray(w, np.float64))
+    # The final report is itself a telemetry event, so a trace directory
+    # alone reconstructs the run (phases + counters + outcome) without
+    # needing the stdout line — what the forensics renderer
+    # (benchmarks/summarize_session.py --telemetry) reads.
+    import dataclasses as _dc
+
+    obs.event("solve.report", **_dc.asdict(report))
+    obs.finalize()
     if args.json:
         print(report.json_line())
         return 0
@@ -716,6 +834,9 @@ def main(argv=None) -> int:
         print("\n".join(_categories_table(problem, cat_dtype, report.iterations)))
     if args.profile:
         print(f"profiler trace written to {args.profile}")
+    if args.trace_dir:
+        print(f"telemetry written to {args.trace_dir} (open the "
+              f".trace.json in https://ui.perfetto.dev)", file=sys.stderr)
     return 0
 
 

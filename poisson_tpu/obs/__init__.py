@@ -1,0 +1,174 @@
+"""Unified telemetry: spans, counters, and streamed convergence.
+
+One subsystem replaces the four ad-hoc sinks that grew around the solve
+stack (PhaseTimer dicts, watchdog heartbeat JSON, restart history inside
+``DivergenceError``, bench session.jsonl):
+
+- **spans** (:mod:`poisson_tpu.obs.trace`) — nestable fenced timed
+  regions, emitted as Chrome/Perfetto trace JSON plus a structured JSONL
+  event log, with rank attribution so multihost runs merge into one
+  timeline;
+- **counters** (:mod:`poisson_tpu.obs.metrics`) — an always-on process-
+  wide registry (restarts, CRC failures, watchdog beats, iterations by
+  stop-flag, …) snapshotted to JSON at exit and merged per rank;
+- **streamed convergence** (:mod:`poisson_tpu.obs.stream`) — opt-in
+  per-iteration residuals out of the fused ``lax.while_loop`` via
+  ``jax.debug.callback`` (off by default; golden counts stay bit-exact).
+
+Usage (the CLI wires this from ``--trace-dir``/``--metrics-out``/
+``--stream-every``; ``bench.py`` from ``POISSON_TPU_TRACE_DIR`` etc.):
+
+    from poisson_tpu import obs
+    obs.configure(trace_dir="tm", metrics_path="m.json", stream_every=50)
+    with obs.span("solve"):
+        result = pcg_solve(problem, stream_every=50)
+    obs.finalize()
+
+Everything degrades to near-zero-cost no-ops when unconfigured:
+``obs.span`` becomes an un-fenced null context, ``obs.event`` drops the
+record, counters still count (a locked dict add), streaming is not even
+traced into the program. ``python -m poisson_tpu.obs.selfcheck`` smoke-
+tests the whole round trip.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+from typing import Optional
+
+from poisson_tpu.obs import metrics, stream, trace
+from poisson_tpu.obs.metrics import gauge, inc
+from poisson_tpu.obs.trace import (
+    TraceRecorder,
+    load_events,
+    merge_trace_dir,
+)
+
+_RECORDER: Optional[TraceRecorder] = None
+_METRICS_PATH: Optional[str] = None
+_STREAM_EVERY: int = 0
+_ATEXIT_REGISTERED = False
+
+
+def configure(trace_dir: Optional[str] = None,
+              metrics_path: Optional[str] = None,
+              stream_every: int = 0,
+              stream_live: bool = False,
+              rank: Optional[int] = None) -> TraceRecorder:
+    """Install the process-wide telemetry configuration.
+
+    ``trace_dir``: spans/events land in ``trace-rank{R}.trace.json`` +
+    ``events-rank{R}.jsonl`` there (plus ``metrics-rank{R}.json`` and
+    ``stream-rank{R}.jsonl`` at finalize). ``metrics_path``: additional
+    single-file counters snapshot. ``stream_every``: installs a
+    :class:`~poisson_tpu.obs.stream.StreamSink`; the value must ALSO be
+    passed to the solver (it is a static compile flag — ``configure``
+    only sets up the host side). Finalization runs at interpreter exit;
+    call :func:`finalize` earlier for deterministic artifact timing.
+    """
+    global _RECORDER, _METRICS_PATH, _STREAM_EVERY, _ATEXIT_REGISTERED
+    shutdown()
+    _RECORDER = TraceRecorder(trace_dir=trace_dir, rank=rank)
+    _METRICS_PATH = metrics_path
+    _STREAM_EVERY = max(0, int(stream_every))
+    if _STREAM_EVERY > 0:
+        path = None
+        if trace_dir:
+            import os
+
+            path = os.path.join(trace_dir,
+                                f"stream-rank{_RECORDER.rank}.jsonl")
+        stream.set_sink(stream.StreamSink(path=path, live=stream_live))
+    if not _ATEXIT_REGISTERED:
+        atexit.register(finalize)
+        _ATEXIT_REGISTERED = True
+    return _RECORDER
+
+
+def configure_from_env() -> Optional[TraceRecorder]:
+    """Configure from ``POISSON_TPU_TRACE_DIR`` / ``POISSON_TPU_METRICS_OUT``
+    / ``POISSON_TPU_STREAM_EVERY`` — the env-driven path for harnesses
+    (``bench.py``) whose argv is already spoken for. No-op (returns
+    None) when none of the variables are set."""
+    import os
+
+    trace_dir = os.environ.get("POISSON_TPU_TRACE_DIR") or None
+    metrics_path = os.environ.get("POISSON_TPU_METRICS_OUT") or None
+    try:
+        stream_every = int(os.environ.get("POISSON_TPU_STREAM_EVERY", "0"))
+    except ValueError:
+        stream_every = 0
+    if not (trace_dir or metrics_path or stream_every > 0):
+        return None
+    return configure(trace_dir=trace_dir, metrics_path=metrics_path,
+                     stream_every=stream_every)
+
+
+def recorder() -> Optional[TraceRecorder]:
+    """The active recorder, or None when telemetry is unconfigured."""
+    return _RECORDER
+
+
+def stream_every() -> int:
+    """The configured streaming stride (0 = off) — what the CLI passes
+    into the solver entry points."""
+    return _STREAM_EVERY
+
+
+def span(name: str, fence: bool = True, **args):
+    """A span on the active recorder, or a null context when telemetry
+    is unconfigured (so call sites never need to guard)."""
+    if _RECORDER is not None:
+        return _RECORDER.span(name, fence=fence, **args)
+    return contextlib.nullcontext()
+
+
+def event(name: str, **fields) -> None:
+    """An instant event on the active recorder (dropped when off)."""
+    if _RECORDER is not None:
+        _RECORDER.event(name, **fields)
+
+
+def recent_events() -> list:
+    """Last N events (for stall diagnostics); [] when unconfigured."""
+    if _RECORDER is not None:
+        return _RECORDER.recent_events()
+    return []
+
+
+def finalize() -> None:
+    """Flush every artifact: the Chrome trace, the metrics snapshot(s),
+    the stream sink. Idempotent; safe with no configuration."""
+    import os
+
+    stream.drain()
+    sink = stream.get_sink()
+    if sink is not None:
+        sink.finish()
+    rec = _RECORDER
+    if rec is not None:
+        rec.flush()
+        if rec.trace_dir:
+            metrics.write_snapshot(
+                os.path.join(rec.trace_dir,
+                             f"metrics-rank{rec.rank}.json"),
+                rank=rec.rank,
+            )
+    if _METRICS_PATH:
+        metrics.write_snapshot(_METRICS_PATH,
+                               rank=rec.rank if rec else None)
+
+
+def shutdown() -> None:
+    """Finalize and tear down the configuration (tests; back-to-back
+    runs in one process)."""
+    global _RECORDER, _METRICS_PATH, _STREAM_EVERY
+    if _RECORDER is not None or _METRICS_PATH or stream.get_sink():
+        finalize()
+    rec, _RECORDER = _RECORDER, None
+    if rec is not None:
+        rec.close()
+    stream.set_sink(None)
+    _METRICS_PATH = None
+    _STREAM_EVERY = 0

@@ -1,0 +1,142 @@
+"""Counters: the process-wide metrics pillar of the telemetry subsystem.
+
+A flat registry of named counters (monotone adds) and gauges (last-set
+values), always on — incrementing a counter is a dict add under a lock,
+cheap enough to leave in every code path unconditionally, so the
+instrumented call sites (solvers, checkpoints, watchdog, multihost init)
+never need to know whether telemetry is configured. Snapshots are
+written as JSON at exit by :func:`poisson_tpu.obs.configure` (to
+``--metrics-out`` and/or ``metrics-rank{R}.json`` in the trace dir) and
+per-rank snapshots merge with :func:`merge` (counters sum across ranks;
+gauges keep per-rank values — a max would hide a straggler).
+
+Naming convention (dotted, low cardinality):
+
+- ``pcg.solves.<verdict>`` / ``pcg.iterations.<verdict>`` — solve count
+  and iteration count by stop-flag name (``solvers.pcg.FLAG_NAMES``);
+- ``resilient.restarts`` / ``resilient.escalations``;
+- ``checkpoint.writes`` / ``checkpoint.crc_failures`` /
+  ``checkpoint.corrupt`` / ``checkpoint.generation_fallbacks``;
+- ``watchdog.beats`` / ``watchdog.stalls``;
+- ``multihost.init_retries`` / ``multihost.degraded``;
+- ``time.compile_seconds`` / ``time.execute_seconds`` (accumulating
+  float counters: compile vs execute wall time).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+_LOCK = threading.Lock()
+_COUNTERS: dict[str, float] = {}
+_GAUGES: dict[str, object] = {}
+
+
+def inc(name: str, value: float = 1) -> None:
+    """Add ``value`` to counter ``name`` (creating it at 0)."""
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + value
+
+
+def gauge(name: str, value) -> None:
+    """Set gauge ``name`` to ``value`` (last write wins)."""
+    with _LOCK:
+        _GAUGES[name] = value
+
+
+def get(name: str, default: float = 0) -> float:
+    """Current value of counter ``name`` (0 when never incremented)."""
+    with _LOCK:
+        return _COUNTERS.get(name, default)
+
+
+def reset() -> None:
+    """Clear the registry (tests; a library user embedding several runs
+    in one process)."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+
+
+def snapshot(rank: Optional[int] = None) -> dict:
+    """The registry as one JSON-ready dict, stamped with rank and both
+    clocks (wall for cross-host alignment, monotonic for stall math)."""
+    if rank is None:
+        from poisson_tpu.obs.trace import default_rank
+
+        rank = default_rank()
+    with _LOCK:
+        return {
+            "schema": "poisson_tpu.obs.metrics/1",
+            "rank": rank,
+            "pid": os.getpid(),
+            "at_unix": time.time(),
+            "at_mono": time.monotonic(),
+            "counters": dict(_COUNTERS),
+            "gauges": dict(_GAUGES),
+        }
+
+
+def write_snapshot(path: str, rank: Optional[int] = None) -> None:
+    """Atomically write :func:`snapshot` to ``path``. Best-effort: a
+    failing metrics disk must never take the solve down with it."""
+    snap = snapshot(rank)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(snap, f, sort_keys=True, indent=1, default=str)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+
+
+def merge(snapshots: list[dict]) -> dict:
+    """Merge per-rank snapshots: counters sum; gauges are kept per rank
+    under ``gauges_by_rank`` (aggregating them would hide stragglers)."""
+    counters: dict[str, float] = {}
+    gauges_by_rank: dict[str, dict] = {}
+    ranks = []
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        rank = snap.get("rank", "?")
+        ranks.append(rank)
+        for name, val in (snap.get("counters") or {}).items():
+            try:
+                counters[name] = counters.get(name, 0) + val
+            except TypeError:
+                continue
+        g = snap.get("gauges") or {}
+        if g:
+            gauges_by_rank[str(rank)] = dict(g)
+    return {
+        "schema": "poisson_tpu.obs.metrics/merged-1",
+        "ranks": ranks,
+        "counters": counters,
+        "gauges_by_rank": gauges_by_rank,
+    }
+
+
+def load_dir(trace_dir: str) -> dict:
+    """Read every ``metrics-rank*.json`` under ``trace_dir`` and return
+    their :func:`merge` ({} counters when none exist)."""
+    snaps = []
+    for fname in sorted(os.listdir(trace_dir)):
+        if not (fname.startswith("metrics-rank")
+                and fname.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(trace_dir, fname)) as f:
+                snaps.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return merge(snaps)

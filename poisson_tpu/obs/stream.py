@@ -1,0 +1,145 @@
+"""Streamed convergence: per-iteration residual telemetry out of the
+fused loop.
+
+The whole solve is one fused device program (``lax.while_loop``) — the
+design the reference lost 20%+ by not having (BASELINE Table 2) — so
+nothing normally leaves the device until the loop exits. That is also
+why a long solve is a black box while it runs. This module opens an
+opt-in window without breaking the one-fused-program design:
+
+- ``make_pcg_body(..., stream_every=K)`` plants a
+  ``jax.debug.callback`` behind a ``lax.cond`` so every K-th iteration
+  ships two scalars (k, ‖Δw‖) to the host, asynchronously and
+  unordered — telemetry, not control flow;
+- the host-side tap (:func:`device_tap`) forwards to whatever
+  :class:`StreamSink` is active: an in-memory curve, an appended
+  ``stream-rank{R}.jsonl``, and (opt-in) a live one-line progress
+  display on stderr.
+
+OFF BY DEFAULT, and structurally so: with ``stream_every=0`` (the
+default everywhere) no callback is traced into the program at all, so
+golden iteration counts stay bit-for-bit identical — the flag is a
+static argument of the jitted solves, part of the compile cache key.
+The callback identity is the module-level :func:`device_tap`, so an
+already-compiled streaming program keeps working when the sink is
+swapped (or removed) between runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+_LOCK = threading.Lock()
+_SINK: Optional["StreamSink"] = None
+
+
+class StreamSink:
+    """Host-side receiver for streamed (k, ‖Δw‖) samples.
+
+    ``path``: append samples as JSONL (None: memory only). ``live``:
+    overwrite a one-line progress display on stderr per sample.
+    ``min_interval``: floor (seconds) between live repaints so a fast
+    solve does not flood the terminal; recording is never throttled.
+    """
+
+    def __init__(self, path: Optional[str] = None, live: bool = False,
+                 min_interval: float = 0.1, label: str = "solve"):
+        self.path = path
+        self.live = live
+        self.min_interval = min_interval
+        self.label = label
+        self.samples: list[tuple[int, float]] = []
+        self._file = None
+        self._last_paint = 0.0
+        self._lock = threading.Lock()
+
+    def emit(self, k: int, diff: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.samples.append((k, diff))
+            if self.path is not None:
+                try:
+                    if self._file is None:
+                        d = os.path.dirname(os.path.abspath(self.path))
+                        os.makedirs(d, exist_ok=True)
+                        self._file = open(self.path, "a")
+                    self._file.write(json.dumps(
+                        {"k": k, "diff": diff, "at_unix": time.time(),
+                         "at_mono": now}) + "\n")
+                    self._file.flush()
+                except (OSError, ValueError):
+                    pass
+            paint = self.live and (now - self._last_paint
+                                   >= self.min_interval)
+            if paint:
+                self._last_paint = now
+        if paint:
+            print(f"\r{self.label}: iter {k}  ||dw|| {diff:.3e}   ",
+                  end="", file=sys.stderr, flush=True)
+
+    def finish(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+        if self.live and self.samples:
+            print(file=sys.stderr)      # leave the last progress line
+
+
+def set_sink(sink: Optional[StreamSink]) -> Optional[StreamSink]:
+    """Install the process-wide sink; returns the previous one."""
+    global _SINK
+    with _LOCK:
+        prev, _SINK = _SINK, sink
+    return prev
+
+
+def get_sink() -> Optional[StreamSink]:
+    return _SINK
+
+
+def device_tap(k, diff) -> None:
+    """The ``jax.debug.callback`` target: stable module-level identity
+    (part of the traced program), dynamic dispatch to the active sink.
+    With no sink the sample is dropped — a compiled streaming program
+    stays valid across runs that do not record."""
+    sink = _SINK
+    if sink is not None:
+        try:
+            sink.emit(int(k), float(diff))
+        except Exception:
+            pass    # telemetry must never take the solve down
+
+
+def emit_every(stream_every: int, k, diff) -> None:
+    """Plant the streaming tap in a traced loop body: every
+    ``stream_every``-th iteration ships (k, ‖Δw‖) to :func:`device_tap`.
+    Call only with ``stream_every > 0`` — the caller's static flag is
+    what keeps non-streaming programs byte-identical."""
+    import jax
+    from jax import lax
+
+    lax.cond(
+        (k % stream_every) == 0,
+        lambda: jax.debug.callback(device_tap, k, diff),
+        lambda: None,
+    )
+
+
+def drain() -> None:
+    """Wait for in-flight callbacks (the device may still be shipping
+    samples when the loop result is already fetched)."""
+    try:
+        import jax
+
+        jax.effects_barrier()
+    except Exception:
+        pass

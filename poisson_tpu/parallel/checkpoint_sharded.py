@@ -288,12 +288,22 @@ def pcg_solve_sharded_checkpointed(problem: Problem, mesh: Mesh,
         state = _to_padded_global(saved, problem,
                                   px_size * m_blk, py_size * n_blk, mesh)
 
+    def to_portable(s):
+        # The full-grid gather is the expensive part of a sharded
+        # checkpoint (an all-gather collective on multi-process meshes) —
+        # span it so slow checkpoints are visible on the timeline.
+        from poisson_tpu import obs
+
+        with obs.span("checkpoint.gather", fence=False,
+                      mesh=f"{px_size}x{py_size}"):
+            return _to_full_grid(_fetchable(s, mesh), problem)
+
     state = run_chunked(
         state,
         advance=lambda s: _chunk_sharded(problem, mesh, use_scaled, chunk,
                                          stagnation_window,
                                          a_blk, b_blk, aux_blk, s),
-        to_portable=lambda s: _to_full_grid(_fetchable(s, mesh), problem),
+        to_portable=to_portable,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint, primary=is_primary, sync=_sync,
         keep_last=keep_last, watchdog=watchdog, on_chunk=on_chunk,

@@ -106,6 +106,12 @@ def initialize_multihost(coordinator: Optional[str] = None,
             elif _is_transient(msg) and attempt < max_retries:
                 attempt += 1
                 delay = backoff_seconds * (2.0 ** (attempt - 1))
+                from poisson_tpu import obs
+
+                obs.inc("multihost.init_retries")
+                obs.event("multihost.init_retry", attempt=attempt,
+                          max_retries=max_retries, delay_seconds=delay,
+                          error=str(e)[:200])
                 warnings.warn(
                     f"distributed init failed transiently ({e}); retry "
                     f"{attempt}/{max_retries} in {delay:.1f}s",
@@ -118,6 +124,11 @@ def initialize_multihost(coordinator: Optional[str] = None,
                 # degrade rather than wedge every host on a dead
                 # coordinator. Checked before the quiet no-cluster branch —
                 # transient messages often mention the coordinator too.
+                from poisson_tpu import obs
+
+                obs.inc("multihost.degraded")
+                obs.event("multihost.degraded", retries=max_retries,
+                          error=str(e)[:200])
                 warnings.warn(
                     f"distributed init still failing after {max_retries} "
                     f"retries ({e}); continuing single-host — this "

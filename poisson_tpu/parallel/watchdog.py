@@ -9,15 +9,22 @@ simply sat until the scheduler killed it.
 Design: the chunked solve drivers (``solvers.checkpoint.run_chunked``)
 call :meth:`Watchdog.beat` at every chunk boundary. The watchdog
 
-- writes a small JSON heartbeat file (atomic tmp+rename) on every beat, so
+- writes a small JSON heartbeat file (atomic tmp+rename) on every beat —
+  with BOTH wall (``at_unix``) and monotonic (``at_mono``) timestamps, so
   an *external* supervisor — or a human with ``cat`` — can tell a slow
-  solve from a dead one without attaching a debugger; and
+  solve from a dead one without attaching a debugger, and a host clock
+  jump can neither fake nor mask a stall;
+- mirrors every beat and stall into the unified telemetry stream
+  (``poisson_tpu.obs``: ``watchdog.beats``/``watchdog.stalls`` counters +
+  events), so the event log carries the same liveness record; and
 - optionally arms a monitor thread with a timeout: if no beat lands within
-  ``timeout`` seconds, it writes a diagnostics file next to the heartbeat
-  (last-known iteration, residual, elapsed) and invokes ``on_timeout`` —
-  by default logging the diagnostics to stderr and interrupting the main
-  thread so the solve aborts with a clean ``SolveTimeout`` traceback
-  instead of hanging forever.
+  ``timeout`` seconds (measured on the monotonic clock), it writes a
+  diagnostics file next to the heartbeat (last-known iteration, residual,
+  monotonic AND wall elapsed, plus the last N telemetry events — what the
+  solve was actually doing) and invokes ``on_timeout`` — by default
+  logging the diagnostics to stderr and interrupting the main thread so
+  the solve aborts with a clean ``SolveTimeout`` traceback instead of
+  hanging forever.
 
 The monitor thread is a daemon and holds no JAX state; a wedged device
 call cannot block it. Note the first beat only lands after the first
@@ -90,6 +97,7 @@ class Watchdog:
         self._clock = clock
         self._lock = threading.Lock()
         self._last_beat = None
+        self._last_beat_wall = None
         self._last_info: dict = {}
         self._beats = 0
         self._fired = False
@@ -107,6 +115,7 @@ class Watchdog:
                 return self
             self._fired = False
             self._last_beat = self._clock()
+            self._last_beat_wall = time.time()
             self._stop_event.clear()
             if self.timeout is not None:
                 self._thread = threading.Thread(
@@ -140,12 +149,21 @@ class Watchdog:
     def beat(self, **info) -> None:
         """Record liveness (called at every chunk boundary). ``info`` is
         free-form progress metadata (iteration, residual, …) included in
-        the heartbeat file and in any timeout diagnostics."""
+        the heartbeat file and in any timeout diagnostics. Each beat is
+        also a telemetry event (``watchdog.beat`` counter + event with
+        wall AND monotonic timestamps), so the unified event log carries
+        the same liveness record the heartbeat file does."""
+        from poisson_tpu import obs
+
         with self._lock:
             self._last_beat = self._clock()
+            self._last_beat_wall = time.time()
             self._last_info = dict(info)
             self._beats += 1
+            beats = self._beats
         self._write_heartbeat()
+        obs.inc("watchdog.beats")
+        obs.event("watchdog.beat", beats=beats, **info)
 
     def elapsed_since_beat(self) -> float:
         with self._lock:
@@ -174,8 +192,12 @@ class Watchdog:
     def _write_heartbeat(self) -> None:
         if not self.heartbeat_path:
             return
+        # Both clocks: wall for humans/cross-host alignment, monotonic so
+        # stall arithmetic survives a host clock jump (NTP step, VM
+        # migration) — a jump can neither fake nor mask a stall.
         payload = {
             "at_unix": time.time(),
+            "at_mono": time.monotonic(),
             "pid": os.getpid(),
             "beats": self._beats,
             **self._last_info,
@@ -196,15 +218,36 @@ class Watchdog:
     # -- monitor -------------------------------------------------------
 
     def _diagnostics(self, elapsed: float) -> dict:
+        from poisson_tpu import obs
+
+        # elapsed_seconds is MONOTONIC (the default clock): the stall
+        # verdict itself cannot be faked or masked by a host clock jump.
+        # The wall-clock view is recorded alongside — a large disagreement
+        # between the two is itself diagnostic (the clock jumped).
+        wall_elapsed = (
+            time.time() - self._last_beat_wall
+            if self._last_beat_wall is not None else None
+        )
         return {
             "elapsed_seconds": round(elapsed, 3),
+            "elapsed_wall_seconds": (
+                round(wall_elapsed, 3) if wall_elapsed is not None else None
+            ),
+            "at_unix": time.time(),
+            "at_mono": time.monotonic(),
             "timeout_seconds": self.timeout,
             "beats": self._beats,
             "pid": os.getpid(),
             "last_progress": dict(self._last_info),
+            # The last N unified-telemetry events (spans, checkpoint
+            # writes, restarts, …): what the solve was actually doing
+            # when it stopped beating — the round-5 forensic gap.
+            "recent_events": obs.recent_events(),
         }
 
     def _monitor(self) -> None:
+        from poisson_tpu import obs
+
         while not self._stop_event.wait(self.poll_interval):
             with self._lock:
                 elapsed = self._clock() - self._last_beat
@@ -214,6 +257,11 @@ class Watchdog:
                     diag = self._diagnostics(elapsed)
                     self.fired_diagnostics = diag
             if expired:
+                obs.inc("watchdog.stalls")
+                obs.event("watchdog.stall",
+                          elapsed_seconds=diag["elapsed_seconds"],
+                          timeout_seconds=self.timeout,
+                          beats=diag["beats"])
                 self._write_diagnostics(diag)
                 self.on_timeout(diag)
                 return

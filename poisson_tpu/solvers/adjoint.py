@@ -51,7 +51,7 @@ def _make_differentiable(problem: Problem, dtype_name: str, scaled: bool):
     def solve_fn(_matvec, rhs):
         # rhs arrives ring-projected; the scaled system takes b̃ = sc·B.
         r = rhs * aux if scaled else rhs
-        return _solve(problem, scaled, a, b, r, aux).w
+        return _solve(problem, scaled, 0, a, b, r, aux).w
 
     def solve(rhs_grid):
         rhs_proj = pad_interior(interior(rhs_grid))

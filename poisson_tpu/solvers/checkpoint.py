@@ -84,9 +84,9 @@ def _fingerprint(problem: Problem, dtype_name: str, scaled: bool) -> str:
     return repr((sorted(fields.items()), dtype_name, scaled))
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
 def _run_chunk(problem: Problem, scaled: bool, chunk: int,
-               stagnation_window: int,
+               stagnation_window: int, stream_every: int,
                a, b, aux, state: PCGState) -> PCGState:
     """Advance the solve by at most ``chunk`` iterations (device-resident)."""
     ops = (
@@ -97,7 +97,7 @@ def _run_chunk(problem: Problem, scaled: bool, chunk: int,
     body = make_pcg_body(
         ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2,
-        stagnation_window=stagnation_window,
+        stagnation_window=stagnation_window, stream_every=stream_every,
     )
     stop_at = jnp.minimum(state.k + chunk, problem.iteration_cap)
 
@@ -191,9 +191,7 @@ def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
         if watchdog is not None:
             watchdog.stop()
     if _converged(state) and not keep_checkpoint and primary():
-        for candidate in checkpoint_generations(path, keep_last):
-            if os.path.exists(candidate):
-                os.remove(candidate)
+        remove_generations(path, keep_last)
     sync("poisson_ckpt_done")           # removal precedes any follow-up solve
     return state
 
@@ -209,6 +207,14 @@ def checkpoint_generations(path: str, keep_last: int = 2) -> list:
     return [path] + [f"{path}.{i}" for i in range(1, keep_last)]
 
 
+def remove_generations(path: str, keep_last: int = 2) -> None:
+    """Delete every retained checkpoint generation (the converged-solve
+    cleanup, shared by all chunked drivers)."""
+    for candidate in checkpoint_generations(path, keep_last):
+        if os.path.exists(candidate):
+            os.remove(candidate)
+
+
 def _payload_crc(fingerprint: str, arrays: dict) -> int:
     crc = zlib.crc32(fingerprint.encode())
     for key in sorted(arrays):
@@ -216,7 +222,10 @@ def _payload_crc(fingerprint: str, arrays: dict) -> int:
         crc = zlib.crc32(key.encode(), crc)
         crc = zlib.crc32(str(a.dtype).encode(), crc)
         crc = zlib.crc32(str(a.shape).encode(), crc)
-        crc = zlib.crc32(a.tobytes(), crc)
+        # The array itself is a C-contiguous buffer: same CRC as
+        # tobytes(), without materializing a full byte-copy per array
+        # per checkpoint write/load.
+        crc = zlib.crc32(a, crc)
     return crc & 0xFFFFFFFF
 
 
@@ -227,23 +236,28 @@ def save_state(path: str, state: PCGState, fingerprint: str,
     (``path`` → ``path.1`` → …, keeping ``keep_last`` total), then
     ``os.replace`` into place. A kill at any point leaves either the old
     generation chain or the new one — never a partial file at ``path``."""
+    from poisson_tpu import obs
+
     arrays = {key: np.asarray(val) for key, val in zip(_STATE_KEYS, state)}
     # np.savez appends '.npz' to names without it — keep the temp name
     # suffixed so the atomic-replace source path is what savez wrote.
     tmp = f"{path}.{os.getpid()}.tmp.npz"
     try:
-        np.savez(
-            tmp,
-            fingerprint=np.asarray(fingerprint),
-            crc32=np.uint32(_payload_crc(fingerprint, arrays)),
-            **arrays,
-        )
-        generations = checkpoint_generations(path, keep_last)
-        for older, newer in zip(reversed(generations[1:]),
-                                reversed(generations[:-1])):
-            if os.path.exists(newer):
-                os.replace(newer, older)
-        os.replace(tmp, path)
+        with obs.span("checkpoint.write", fence=False, path=path):
+            np.savez(
+                tmp,
+                fingerprint=np.asarray(fingerprint),
+                crc32=np.uint32(_payload_crc(fingerprint, arrays)),
+                **arrays,
+            )
+            generations = checkpoint_generations(path, keep_last)
+            for older, newer in zip(reversed(generations[1:]),
+                                    reversed(generations[:-1])):
+                if os.path.exists(newer):
+                    os.replace(newer, older)
+            os.replace(tmp, path)
+        obs.inc("checkpoint.writes")
+        obs.event("checkpoint.write", path=path, k=int(arrays["k"]))
     finally:
         if os.path.exists(tmp):   # savez died mid-write: no partials left
             os.remove(tmp)
@@ -278,6 +292,10 @@ def _read_state(path: str, fingerprint: str) -> PCGState:
         # an npy *header* escapes as SyntaxError/TokenError from numpy's
         # header parser — the failure set is open-ended by construction.
         # (The fingerprint-mismatch ValueError is raised after this block.)
+        from poisson_tpu import obs
+
+        obs.inc("checkpoint.corrupt")
+        obs.event("checkpoint.corrupt", path=path, error=type(e).__name__)
         raise CorruptCheckpointError(
             f"checkpoint {path} is unreadable: {type(e).__name__}: {e}"
         ) from e
@@ -291,6 +309,12 @@ def _read_state(path: str, fingerprint: str) -> PCGState:
         actual = _payload_crc(saved, {k: np.asarray(v)
                                       for k, v in vals.items()})
         if actual != stored_crc:
+            from poisson_tpu import obs
+
+            obs.inc("checkpoint.crc_failures")
+            obs.event("checkpoint.crc_failure", path=path,
+                      stored=f"{stored_crc:#010x}",
+                      payload=f"{actual:#010x}")
             raise CorruptCheckpointError(
                 f"checkpoint {path} failed its integrity check "
                 f"(stored CRC32 {stored_crc:#010x}, payload "
@@ -312,48 +336,65 @@ def _read_state(path: str, fingerprint: str) -> PCGState:
     )
 
 
-def load_state(path: str, fingerprint: str,
-               keep_last: int = 2) -> Optional[PCGState]:
-    """Returns the newest trustworthy saved state, or None if no
-    generation exists or every generation is corrupt (a corrupt-only chain
-    warns and starts over rather than crashing the resume). A corrupt or
-    mismatched newest generation falls back to ``path.1``, ``path.2``, …;
-    a fingerprint mismatch with no loadable older generation raises (the
-    checkpoint belongs to a different problem — resuming would silently
-    solve the wrong one)."""
+def load_state_any(path: str, fingerprints, keep_last: int = 2,
+                   ) -> Optional[tuple[PCGState, int]]:
+    """The one generation-walk loader: newest generation first, and
+    within each generation the given ``fingerprints`` in preference
+    order. Returns ``(state, index-of-matched-fingerprint)``, or None if
+    no generation exists or every generation is corrupt (a corrupt-only
+    chain warns and starts over rather than crashing the resume). A
+    corrupt or mismatched newest generation falls back to ``path.1``,
+    ``path.2``, …; a mismatch with no loadable older generation raises
+    (the checkpoint belongs to a different problem — resuming would
+    silently solve the wrong one). An unreadable/corrupt generation is
+    skipped outright — no fingerprint could rescue it."""
+    fingerprints = list(fingerprints)
     mismatch: Optional[ValueError] = None
     existed = 0
     for candidate in checkpoint_generations(path, keep_last):
         if not os.path.exists(candidate):
             continue
         existed += 1
-        try:
-            state = _read_state(candidate, fingerprint)
-        except CorruptCheckpointError as e:
-            warnings.warn(
-                f"{e} — falling back to the previous checkpoint generation",
-                RuntimeWarning, stacklevel=2,
-            )
-            continue
-        except ValueError as e:
-            mismatch = mismatch or e
-            continue
-        if candidate != path:
-            warnings.warn(
-                f"resuming from older checkpoint generation {candidate} "
-                f"(newest was corrupt or mismatched)",
-                RuntimeWarning, stacklevel=2,
-            )
-        return state
+        for index, fingerprint in enumerate(fingerprints):
+            try:
+                state = _read_state(candidate, fingerprint)
+            except CorruptCheckpointError as e:
+                warnings.warn(
+                    f"{e} — falling back to the previous checkpoint "
+                    f"generation", RuntimeWarning, stacklevel=3,
+                )
+                break   # unreadable regardless of fingerprint
+            except ValueError as e:
+                mismatch = mismatch or e
+                continue
+            if candidate != path:
+                from poisson_tpu import obs
+
+                obs.inc("checkpoint.generation_fallbacks")
+                obs.event("checkpoint.generation_fallback", path=candidate)
+                warnings.warn(
+                    f"resuming from older checkpoint generation "
+                    f"{candidate} (newest was corrupt or mismatched)",
+                    RuntimeWarning, stacklevel=3,
+                )
+            return state, index
     if mismatch is not None:
         raise mismatch
     if existed:
         warnings.warn(
             f"all {existed} checkpoint generation(s) at {path} are "
             f"corrupt; starting the solve from iteration zero",
-            RuntimeWarning, stacklevel=2,
+            RuntimeWarning, stacklevel=3,
         )
     return None
+
+
+def load_state(path: str, fingerprint: str,
+               keep_last: int = 2) -> Optional[PCGState]:
+    """Returns the newest trustworthy saved state for ``fingerprint``, or
+    None (see :func:`load_state_any` for the fallback semantics)."""
+    found = load_state_any(path, [fingerprint], keep_last)
+    return None if found is None else found[0]
 
 
 def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
@@ -361,6 +402,7 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                            keep_checkpoint: bool = False,
                            keep_last: int = 2,
                            stagnation_window: int = 0,
+                           stream_every: int = 0,
                            watchdog=None,
                            on_chunk=None) -> PCGResult:
     """Solve with periodic state persistence and automatic resume.
@@ -394,7 +436,8 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     state = run_chunked(
         state,
         advance=lambda s: _run_chunk(problem, use_scaled, chunk,
-                                     stagnation_window, a, b, aux, s),
+                                     stagnation_window, int(stream_every),
+                                     a, b, aux, s),
         to_portable=lambda s: s,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint, keep_last=keep_last,

@@ -111,6 +111,12 @@ class PCGResult(NamedTuple):
     diff: jnp.ndarray        # final update norm
     residual_dot: jnp.ndarray  # final ζ = (D⁻¹r, r)
     flag: jnp.ndarray = np.int32(FLAG_NONE)  # termination verdict (FLAG_*)
+    # Recovery provenance, set by the resilient driver on host-side
+    # results only (None/() are empty pytree nodes, so jitted solvers
+    # returning the defaults stay valid jit outputs). A solve that
+    # recovered and then converged is no longer silent about it.
+    restarts: object = None            # int: recovery attempts taken
+    recovery_history: tuple = ()       # ((iteration, verdict, action), …)
 
 
 def _select(pred, new, old):
@@ -149,7 +155,8 @@ def restart_state(ops: PCGOps, rhs, w) -> PCGState:
 
 
 def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
-                  h1: float, h2: float, stagnation_window: int = 0):
+                  h1: float, h2: float, stagnation_window: int = 0,
+                  stream_every: int = 0):
     """One PCG iteration as a pure state→state function — shared by the
     convergence ``while_loop`` (:func:`pcg_loop`) and the fixed-budget
     diagnostic ``scan`` (``solvers.history``).
@@ -162,6 +169,12 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
     iterations without a new best ‖Δw‖ set FLAG_STAGNATED. The checks only
     ever stop iterations that could no longer converge, so converging
     solves keep their golden iteration counts bit-for-bit.
+
+    ``stream_every`` > 0 additionally ships (k, ‖Δw‖) to the host-side
+    telemetry sink every that many iterations (``obs.stream``) via an
+    unordered ``jax.debug.callback`` — progress visibility out of the
+    fused loop. It is a trace-time constant: at the default 0 no
+    callback exists in the program and the iterations are untouched.
     """
 
     def body(s: PCGState) -> PCGState:
@@ -180,6 +193,11 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
         z_new = ops.apply_Dinv(r_new)
         zr_new = ops.dot(z_new, r_new)
         converged = diff < delta
+
+        if stream_every > 0:
+            from poisson_tpu.obs.stream import emit_every
+
+            emit_every(stream_every, s.k + 1, diff)
 
         beta = zr_new / jnp.where(s.zr == 0.0, 1.0, s.zr)
         p_new = z_new + beta * p
@@ -223,11 +241,11 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
 
 def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
              weighted_norm: bool, h1: float, h2: float,
-             stagnation_window: int = 0) -> PCGState:
+             stagnation_window: int = 0, stream_every: int = 0) -> PCGState:
     """Run the PCG while_loop to convergence; backend-agnostic."""
     body = make_pcg_body(
         ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
-        stagnation_window=stagnation_window,
+        stagnation_window=stagnation_window, stream_every=stream_every,
     )
 
     def cond(s: PCGState):
@@ -320,10 +338,12 @@ def host_setup(problem: Problem, dtype_name: str, scaled: bool):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _solve(problem: Problem, scaled: bool, a, b, rhs, aux) -> PCGResult:
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _solve(problem: Problem, scaled: bool, stream_every: int,
+           a, b, rhs, aux) -> PCGResult:
     """jitted solve; ``aux`` is the zero-ring-embedded D (unscaled) or
-    D^{-1/2} (scaled) on the full grid."""
+    D^{-1/2} (scaled) on the full grid. ``stream_every`` is the static
+    telemetry stride (0 = no callback traced in — see ``obs.stream``)."""
     ops = (
         scaled_single_device_ops(problem, a, b, aux)
         if scaled
@@ -334,6 +354,7 @@ def _solve(problem: Problem, scaled: bool, a, b, rhs, aux) -> PCGResult:
         delta=problem.delta, max_iter=problem.iteration_cap,
         weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2,
+        stream_every=stream_every,
     )
     w = s.w * aux if scaled else s.w
     return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr,
@@ -370,7 +391,7 @@ def resolve_scaled(scaled, dtype_name: str) -> bool:
 
 
 def pcg_solve(problem: Problem, dtype=None, scaled=None,
-              rhs_gate=None) -> PCGResult:
+              rhs_gate=None, stream_every: int = 0) -> PCGResult:
     """Single-device solve (the stage0/stage1 workload, SURVEY §3.1).
 
     The iteration is jit-compiled end to end; setup runs on the host in fp64
@@ -380,14 +401,16 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
     (default: on for sub-64-bit dtypes — see :func:`scaled_single_device_ops`).
     ``rhs_gate``, if given, is a traced scalar the RHS is multiplied by —
     pass exactly 1.0 to chain benchmark solves with a data dependency
-    (serialized, bit-identical result).
+    (serialized, bit-identical result). ``stream_every`` > 0 streams
+    (k, ‖Δw‖) to the telemetry sink every that many iterations
+    (``obs.stream``; 0 = off, the program is byte-identical).
     """
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
     a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
-    return _solve(problem, use_scaled, a, b, rhs, aux)
+    return _solve(problem, use_scaled, int(stream_every), a, b, rhs, aux)
 
 
 def pcg_step_fn(problem: Problem, scaled: bool = True):

@@ -36,11 +36,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from poisson_tpu import obs
 from poisson_tpu.config import Problem
 from poisson_tpu.solvers.checkpoint import (
     _fingerprint,
     _run_chunk,
-    checkpoint_generations,
+    remove_generations,
     save_state,
 )
 from poisson_tpu.solvers.pcg import (
@@ -120,49 +121,18 @@ def _load_any_rung(path: str, problem: Problem, dtype_name: str,
     or any higher rung (a previous resilient run may have escalated before
     it was interrupted — its escalated checkpoint outranks the stale
     pre-escalation generation behind it, so generations are walked outermost
-    and rungs innermost)."""
-    from poisson_tpu.solvers.checkpoint import (
-        CorruptCheckpointError,
-        _read_state,
-        checkpoint_generations,
-    )
+    and rungs innermost — exactly ``load_state_any``'s walk order)."""
+    from poisson_tpu.solvers.checkpoint import load_state_any
 
     rungs = [dtype_name] + _rungs_above(dtype_name)
-    fps = {dn: _fingerprint(problem, dn, scaled) for dn in rungs}
-    mismatch = None
-    existed = 0
-    for candidate in checkpoint_generations(path, keep_last):
-        if not os.path.exists(candidate):
-            continue
-        existed += 1
-        for dn in rungs:
-            try:
-                state = _read_state(candidate, fps[dn])
-            except CorruptCheckpointError as e:
-                warnings.warn(
-                    f"{e} — falling back to the previous checkpoint "
-                    f"generation", RuntimeWarning, stacklevel=2,
-                )
-                break   # unreadable regardless of fingerprint
-            except ValueError as e:
-                mismatch = mismatch or e
-                continue
-            if candidate != path:
-                warnings.warn(
-                    f"resuming from older checkpoint generation "
-                    f"{candidate} (newest was corrupt or mismatched)",
-                    RuntimeWarning, stacklevel=2,
-                )
-            return state, dn
-    if mismatch is not None:
-        raise mismatch
-    if existed:
-        warnings.warn(
-            f"all {existed} checkpoint generation(s) at {path} are "
-            f"corrupt; starting the solve from iteration zero",
-            RuntimeWarning, stacklevel=2,
-        )
-    return None, dtype_name
+    found = load_state_any(
+        path, [_fingerprint(problem, dn, scaled) for dn in rungs],
+        keep_last,
+    )
+    if found is None:
+        return None, dtype_name
+    state, index = found
+    return state, rungs[index]
 
 
 def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
@@ -171,6 +141,7 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                         checkpoint_path: Optional[str] = None,
                         keep_last: int = 2,
                         keep_checkpoint: bool = False,
+                        stream_every: int = 0,
                         watchdog=None,
                         on_chunk=None) -> PCGResult:
     """Single-device solve that survives NaN blow-ups, Krylov breakdowns
@@ -204,7 +175,7 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
     cap = problem.iteration_cap
     restarts = 0
     restarts_at_dtype = 0
-    history = []            # (iteration, verdict, dtype, action)
+    history = []            # (iteration, verdict, action)
     last_good = (state.w, int(state.k))   # device-resident (immutable)
     fp = _fingerprint(problem, dtype_name, use_scaled)
     chunks_done = 0
@@ -226,7 +197,8 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
     try:
         while True:
             state = _run_chunk(problem, use_scaled, chunk,
-                               policy.stagnation_window, a, b, aux, state)
+                               policy.stagnation_window, int(stream_every),
+                               a, b, aux, state)
             jax.block_until_ready(state)
             chunks_done += 1
             if watchdog is not None:
@@ -288,6 +260,14 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                       else f"restart@{dtype_name}")
             history.append((int(state.k), FLAG_NAMES.get(flag, str(flag)),
                             action))
+            obs.inc("resilient.restarts")
+            if escalated:
+                obs.inc("resilient.escalations")
+            obs.event("resilient.restart",
+                      iteration=int(state.k),
+                      verdict=FLAG_NAMES.get(flag, str(flag)),
+                      action=action, restart=restarts,
+                      from_iteration=last_good[1])
             warnings.warn(
                 f"solve {FLAG_NAMES.get(flag, str(flag))} at iteration "
                 f"{int(state.k)}; {action} from last good iterate "
@@ -308,12 +288,16 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
 
     if (checkpoint_path and int(state.flag) == FLAG_CONVERGED
             and not keep_checkpoint):
-        for candidate in checkpoint_generations(checkpoint_path, keep_last):
-            if os.path.exists(candidate):
-                os.remove(candidate)
+        remove_generations(checkpoint_path, keep_last)
 
+    # Recovery provenance rides on the result: a solve that restarted
+    # (or escalated) and then converged used to be indistinguishable
+    # from a clean one — the history only ever surfaced inside
+    # DivergenceError. Counters (resilient.*) record the same facts
+    # process-wide for the metrics snapshot.
     w = state.w * aux if use_scaled else state.w
     return PCGResult(
         w=w, iterations=state.k, diff=state.diff, residual_dot=state.zr,
         flag=state.flag,
+        restarts=restarts, recovery_history=tuple(history),
     )

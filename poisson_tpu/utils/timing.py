@@ -49,6 +49,13 @@ def fence(tree) -> None:
 class PhaseTimer:
     """Named wall-clock phases with device fencing.
 
+    Thin compatibility shim over the unified span API
+    (``poisson_tpu.obs``): each phase is an ``obs`` span (fenced at exit
+    — the MPI_Barrier+Wtime idiom, stage2:…cpp:483-490), so when
+    telemetry is configured the phase lands on the Perfetto timeline and
+    in the event log; the accumulated ``times`` dict keeps the historical
+    interface either way.
+
     >>> t = PhaseTimer()
     >>> with t.phase("solve"):
     ...     result = pcg_solve(problem)   # doctest: +SKIP
@@ -63,16 +70,24 @@ class PhaseTimer:
 
         class _Ctx:
             def __enter__(self):
+                from poisson_tpu import obs
+
+                self._span = obs.span(name, fence=False)
+                self._span.__enter__()
                 self._t0 = time.perf_counter()
                 return self
 
             def __exit__(self, *exc):
                 # Fence outstanding device work so the phase boundary is
-                # real (the MPI_Barrier+Wtime idiom, stage2:…cpp:483-490).
+                # real (the MPI_Barrier+Wtime idiom, stage2:…cpp:483-490)
+                # — done here, before the span closes, so both the span's
+                # recorded duration and ``times`` include the fence, and
+                # the fence still runs when telemetry is unconfigured.
                 try:
                     jax.effects_barrier()
                 except Exception:
                     pass
+                self._span.__exit__(*exc)
                 timer.times[name] = timer.times.get(name, 0.0) + (
                     time.perf_counter() - self._t0
                 )
@@ -105,6 +120,15 @@ class SolveReport:
     # Termination verdict name (solvers.pcg.FLAG_NAMES) when the solver
     # stopped for a reason other than convergence; None otherwise.
     stopped: Optional[str] = None
+    # Which solve path ran, and on what silicon — makes CLI records
+    # joinable with bench session records (which already log both).
+    backend: Optional[str] = None
+    device_kind: Optional[str] = None
+    # Recovery provenance (resilient solves): attempts taken and the
+    # (iteration, verdict, action) history — surfaced on SUCCESS too,
+    # not only inside DivergenceError.
+    restarts: Optional[int] = None
+    recovery: Optional[tuple] = None
 
     def json_line(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -115,7 +139,9 @@ class SolveReport:
             f"| Time={self.solve_seconds:.4f} s",
             f"  compile: {self.compile_seconds:.2f} s   dtype: {self.dtype}"
             f"   devices: {self.devices}"
-            + (f"   mesh: {self.mesh[0]}x{self.mesh[1]}" if self.mesh else ""),
+            + (f"   mesh: {self.mesh[0]}x{self.mesh[1]}" if self.mesh else "")
+            + (f"   backend: {self.backend}" if self.backend else "")
+            + (f" [{self.device_kind}]" if self.device_kind else ""),
             f"  throughput: {self.mlups:.0f} MLUPS   final ||dw||: "
             f"{self.final_diff:.3e}"
             + (
@@ -124,6 +150,15 @@ class SolveReport:
                 else ""
             ),
         ]
+        if self.restarts:
+            detail = "; ".join(
+                f"iter {k}: {verdict} -> {action}"
+                for k, verdict, action in (self.recovery or ())
+            )
+            rows.append(
+                f"  recovered: {self.restarts} restart(s)"
+                + (f" ({detail})" if detail else "")
+            )
         if self.stopped is not None:
             rows.append(f"  WARNING: solve stopped without converging "
                         f"({self.stopped})")
@@ -139,19 +174,37 @@ def solve_report(
     devices: int = 1,
     mesh: Optional[tuple[int, int]] = None,
     l2_error: Optional[float] = None,
+    backend: Optional[str] = None,
+    device_kind: Optional[str] = None,
 ) -> SolveReport:
+    from poisson_tpu import obs
+
     iters = int(result.iterations)
     # Verdict-tracking solvers (PCGResult.flag) surface abnormal stops in
     # the report; converged/untracked results stay quiet.
     stopped = None
     flag = getattr(result, "flag", None)
+    flag_name = "untracked"
     if flag is not None:
         from poisson_tpu.solvers.pcg import FLAG_CONVERGED, FLAG_NAMES, \
             FLAG_NONE
 
         flag = int(flag)
+        flag_name = FLAG_NAMES.get(flag, str(flag))
+        if flag == FLAG_NONE:
+            # done-without-verdict (cap hit, or a verdict-less solver
+            # path): count it as what the historical reading was.
+            flag_name = "running"
         if flag not in (FLAG_NONE, FLAG_CONVERGED):
             stopped = FLAG_NAMES.get(flag, str(flag))
+    # Solve-level counters: solves and iterations by stop verdict, plus
+    # compile vs execute seconds (accumulating float counters).
+    obs.inc(f"pcg.solves.{flag_name}")
+    obs.inc(f"pcg.iterations.{flag_name}", iters)
+    obs.inc("time.compile_seconds", max(0.0, compile_seconds))
+    obs.inc("time.execute_seconds", max(0.0, solve_seconds))
+    restarts = getattr(result, "restarts", None)
+    recovery = getattr(result, "recovery_history", None)
     return SolveReport(
         M=problem.M,
         N=problem.N,
@@ -165,4 +218,8 @@ def solve_report(
         mesh=mesh,
         l2_error=l2_error,
         stopped=stopped,
+        backend=backend,
+        device_kind=device_kind,
+        restarts=(int(restarts) if restarts else None),
+        recovery=(tuple(recovery) if restarts and recovery else None),
     )

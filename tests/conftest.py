@@ -46,6 +46,11 @@ def pytest_configure(config):
         "faults: fault-injection suite for the resilience layer "
         "(CPU-fast; runs in tier-1, selectable with -m faults)",
     )
+    config.addinivalue_line(
+        "markers",
+        "obs: unified-telemetry suite (spans/counters/streaming; "
+        "CPU-fast; runs in tier-1, selectable with -m obs)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
