@@ -6,6 +6,15 @@ backend, MLUPS, iterations vs golden, L2 — plus the layout and
 backend-chain decisions. The table is the working draft for the
 post-session BENCH.md update; the jsonl stays the ground truth.
 
+Batched throughput records (``bench.py --batch B`` →
+``{"metric": "batched_solves_per_sec", …}``) render with the value column
+in solves/sec (marked ``sv/s`` — it is NOT an MLUPS figure), the batch
+size and sequential speedup next to the backend, and the
+passes-at-ceiling column blanked (the per-iteration bandwidth model is a
+single-solve model). A record whose per-member iteration counts did not
+match the sequential solver is flagged ``ITER-MISMATCH`` in the status —
+treat it as a correctness incident, not a throughput number.
+
 ``--telemetry DIR`` switches to solve-forensics mode: renders a report
 from a unified-telemetry directory (``poisson_tpu.obs`` — what
 ``python -m poisson_tpu … --trace-dir DIR`` writes): phases and their
@@ -116,8 +125,22 @@ def _row_from(step: str, e: dict) -> list[str] | None:
     status = "ok" if r.get("ok", e.get("ok")) else "FAILED"
     kind = _first(det.get("device_kind"), r.get("device_kind"),
                   r.get("kind"))
-    budget, verdict = _passes_budget(det, kind)
-    return [step, f"{backend} ({platform}) {status}", _fmt(mlups),
+    # Batched throughput records (bench.py --batch): the value column is
+    # solves/sec, not MLUPS; say so inline, and show the batch size plus
+    # the sequential speedup next to the backend. The per-member parity
+    # bit rides in the status so a mismatch is never a quiet "ok".
+    if r.get("metric") == "batched_solves_per_sec":
+        backend = f"{backend} B={det.get('batch', '?')}"
+        if r.get("speedup_vs_sequential") is not None:
+            backend += f" ({r['speedup_vs_sequential']}x vs seq)"
+        if det.get("iterations_match_sequential") is False:
+            status += " ITER-MISMATCH"
+        budget, verdict = "—", ""
+        value_cell = f"{_fmt(mlups)} sv/s"
+    else:
+        budget, verdict = _passes_budget(det, kind)
+        value_cell = _fmt(mlups)
+    return [step, f"{backend} ({platform}) {status}", value_cell,
             _fmt(iters), _fmt(l2), budget + verdict, at]
 
 
@@ -206,6 +229,28 @@ def telemetry_report(tdir: pathlib.Path) -> int:
         if r.get("restarts"):
             print(f"- RECOVERED: {r['restarts']} restart(s): "
                   f"{r.get('recovery')}")
+
+    # Batched throughput records (bench.py --batch / the solve-batched
+    # CLI): solves/sec is the headline, with the per-member parity bit
+    # surfaced — a mismatch is a correctness incident, not a fast run.
+    batched = [e for e in events if e.get("kind") == "event" and e.get(
+        "name") in ("bench.batched", "solve_batched.report")]
+    if batched:
+        print("\n## Batched throughput\n")
+        for e in batched:
+            grid = e.get("grid") or [e.get("M"), e.get("N")]
+            sps = e.get("solves_per_sec")
+            speedup = e.get("speedup",
+                            e.get("speedup_vs_sequential"))
+            match = e.get("iterations_match_sequential",
+                          e.get("iterations_match"))
+            line = (f"- {grid[0]}x{grid[1]} batch={e.get('batch')}: "
+                    f"{sps if sps is not None else '?'} solves/s")
+            if speedup is not None:
+                line += f", {speedup}x vs sequential"
+            if match is False:
+                line += " — PER-MEMBER ITERATIONS MISMATCH"
+            print(line)
 
     # Incidents: everything that is not routine liveness.
     incidents = [e for e in events if e.get("kind") == "event" and e.get(

@@ -11,6 +11,17 @@ same workloads over one interface with every constant promoted to a flag:
                               [--threads T] [--repeat K] [--json]
                               [--categories] [--profile DIR]
 
+plus the batched multi-RHS workload (``solvers.batched`` — hundreds of
+Poisson problems per dispatch):
+
+    python -m poisson_tpu solve-batched M N --batch B [--vary-rhs]
+                              [--compare-sequential] [--dtype ...] [--json]
+
+Both entry points honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the JAX
+persistent compilation cache, ``utils.compile_cache``): traced programs
+persist across processes, and cache hits/misses land in the metrics
+snapshot next to ``time.compile_seconds``.
+
 Instrumentation (stage4's ``MPI_Wtime`` bracketing + timer table, SURVEY §5):
 - phase wall-clock: setup / compile+first-solve / solve (best of --repeat);
 - ``--categories``: reconstructed per-op decomposition of one iteration
@@ -24,6 +35,7 @@ Instrumentation (stage4's ``MPI_Wtime`` bracketing + timer table, SURVEY §5):
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 import time
 from typing import Optional
@@ -600,7 +612,153 @@ def _categories_table(problem: Problem, dtype, iters: int) -> list[str]:
     return lines
 
 
+def build_batched_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m poisson_tpu solve-batched",
+        description="Batched multi-RHS PCG: B Poisson problems in one "
+                    "fused device program (solvers.batched).",
+    )
+    p.add_argument("M", type=int, help="grid cells in x (nodes: M+1)")
+    p.add_argument("N", type=int, help="grid cells in y (nodes: N+1)")
+    p.add_argument("--batch", type=int, required=True, metavar="B",
+                   help="batch size: number of right-hand sides solved "
+                        "per dispatch")
+    p.add_argument("--bucket", type=int, default=None,
+                   help="pad the batch to this executable size (default: "
+                        "the power-of-two bucket ladder)")
+    p.add_argument("--delta", type=float, default=1e-6,
+                   help="convergence threshold on ||w(k+1)-w(k)|| (default 1e-6)")
+    p.add_argument("--max-iter", type=int, default=None,
+                   help="iteration cap (default (M-1)(N-1))")
+    p.add_argument("--dtype", choices=("float32", "float64"), default=None,
+                   help="state precision (default: float64 if x64 on, else float32)")
+    p.add_argument("--vary-rhs", action="store_true",
+                   help="give each member a distinct RHS magnitude "
+                        "(gate 1+i/B) so members converge at different "
+                        "iterations and the per-member masking is visible")
+    p.add_argument("--repeat", type=int, default=1,
+                   help="timed batched-solve repetitions; report the best")
+    p.add_argument("--compare-sequential", action="store_true",
+                   help="also run the B members as sequential single-RHS "
+                        "solves and report throughput speedup + per-member "
+                        "iteration-count parity")
+    p.add_argument("--trace-dir", metavar="DIR", default=None,
+                   help="write unified telemetry here (see the main "
+                        "driver's --trace-dir)")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="write the counters/gauges snapshot here at exit")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line instead of a table")
+    return p
+
+
+def _main_solve_batched(argv) -> int:
+    args = build_batched_parser().parse_args(argv)
+    if args.batch < 1:
+        raise SystemExit(f"--batch must be >= 1, got {args.batch}")
+    if args.repeat < 1:
+        raise SystemExit(f"--repeat must be >= 1, got {args.repeat}")
+    honor_jax_platforms_env()
+    from poisson_tpu import obs
+    from poisson_tpu.utils.compile_cache import enable_from_env
+
+    enable_from_env()
+    if args.trace_dir or args.metrics_out:
+        obs.configure(trace_dir=args.trace_dir,
+                      metrics_path=args.metrics_out)
+    if args.dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+    from poisson_tpu.solvers.batched import bucket_size, solve_batched
+    from poisson_tpu.solvers.pcg import (
+        FLAG_CONVERGED,
+        FLAG_NAMES,
+        pcg_solve,
+        resolve_dtype,
+    )
+
+    problem = Problem(M=args.M, N=args.N, delta=args.delta,
+                      max_iter=args.max_iter)
+    B = args.batch
+    gates = ([1.0 + i / B for i in range(B)] if args.vary_rhs
+             else [1.0] * B)
+
+    run = lambda: solve_batched(problem, rhs_gates=gates,
+                                dtype=args.dtype, bucket=args.bucket)
+    timer = PhaseTimer()
+    with timer.phase("compile_and_first_solve"):
+        result = run()
+        fence(result)
+    best = None
+    with obs.span("timed_batched_solves", fence=False, repeat=args.repeat):
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            result = run()
+            fence(result.iterations)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+
+    iters = [int(k) for k in np.asarray(result.iterations)]
+    flags = [int(f) for f in np.asarray(result.flag)]
+    converged = sum(1 for f in flags if f == FLAG_CONVERGED)
+    bucket = args.bucket if args.bucket is not None else bucket_size(B)
+    record = {
+        "M": problem.M, "N": problem.N, "batch": B, "bucket": bucket,
+        "dtype": resolve_dtype(args.dtype),
+        "batch_seconds": best,
+        "solves_per_sec": B / best,
+        "compile_seconds": timer.times["compile_and_first_solve"] - best,
+        "max_iterations": int(result.max_iterations),
+        "iterations": iters,
+        "converged": converged,
+        "flags": sorted({FLAG_NAMES.get(f, str(f)) for f in flags}),
+    }
+
+    if args.compare_sequential:
+        seq = lambda g: pcg_solve(problem, dtype=args.dtype, rhs_gate=g)
+        fence(seq(gates[0]))           # compile once outside the timing
+        with obs.span("timed_sequential_solves", fence=False, batch=B):
+            t0 = time.perf_counter()
+            seq_iters = []
+            for g in gates:
+                r = seq(g)
+                fence(r.iterations)    # serialize: no cross-solve overlap
+                seq_iters.append(int(r.iterations))
+            seq_seconds = time.perf_counter() - t0
+        record["sequential_seconds"] = seq_seconds
+        record["speedup_vs_sequential"] = seq_seconds / best
+        record["iterations_match_sequential"] = seq_iters == iters
+
+    obs.event("solve_batched.report", **record)
+    obs.gauge("batched.solves_per_sec", record["solves_per_sec"])
+    obs.finalize()
+    if args.json:
+        print(json.dumps(record))
+        return 0
+    lo, hi = min(iters), max(iters)
+    print(f"M={problem.M}, N={problem.N} | batch={B} (bucket {bucket}) "
+          f"| Time={best:.4f} s | {record['solves_per_sec']:.2f} solves/s")
+    print(f"  compile: {record['compile_seconds']:.2f} s   "
+          f"dtype: {record['dtype']}   iterations: "
+          + (f"{lo}" if lo == hi else f"{lo}..{hi} (max {hi})")
+          + f"   converged: {converged}/{B}")
+    if args.compare_sequential:
+        match = ("identical to sequential"
+                 if record["iterations_match_sequential"]
+                 else "MISMATCH vs sequential")
+        print(f"  vs sequential: {record['speedup_vs_sequential']:.2f}x "
+              f"({seq_seconds:.4f} s for {B} solves; per-member "
+              f"iteration counts {match})")
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "solve-batched":
+        return _main_solve_batched(argv[1:])
     args = build_parser().parse_args(argv)
     # Reconcile the positional and flag grid forms: exactly one per axis.
     for axis in ("M", "N"):
@@ -616,6 +774,9 @@ def main(argv=None) -> int:
     # utils.platform for why the env var needs re-asserting (config beats
     # env — the round-2 driver post-mortem).
     honor_jax_platforms_env()
+    from poisson_tpu.utils.compile_cache import enable_from_env
+
+    enable_from_env()
     problem = _problem(args)
     if args.chunk is None:
         # The NaN drill injects at the first chunk BOUNDARY at/after K; a

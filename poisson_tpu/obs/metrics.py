@@ -20,7 +20,16 @@ Naming convention (dotted, low cardinality):
 - ``watchdog.beats`` / ``watchdog.stalls``;
 - ``multihost.init_retries`` / ``multihost.degraded``;
 - ``time.compile_seconds`` / ``time.execute_seconds`` (accumulating
-  float counters: compile vs execute wall time).
+  float counters: compile vs execute wall time);
+- ``compile_cache.hits`` / ``compile_cache.misses`` — JAX persistent
+  compilation cache traffic (``utils.compile_cache``, enabled by the
+  ``POISSON_TPU_COMPILE_CACHE`` env var), read next to
+  ``time.compile_seconds`` to answer "reused or recompiled?";
+- ``batched.solves`` / ``batched.padding_members`` /
+  ``batched.bucket_cache.hits`` / ``batched.bucket_cache.misses`` —
+  multi-RHS driver traffic (``solvers.batched``): members solved, padding
+  overhead, and whether ragged batch sizes are reusing bucket
+  executables.
 """
 
 from __future__ import annotations

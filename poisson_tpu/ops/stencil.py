@@ -9,10 +9,19 @@ over static shapes, which XLA tiles onto the VPU and fuses with neighbouring
 elementwise work — there is no analog of stage4's kernel-launch +
 ``cudaDeviceSynchronize`` per op (``…cu:860,886,913,940``).
 
-Array convention: full grids of shape (M+1, N+1); the Dirichlet ring
+Array convention: full grids of shape (…, M+1, N+1); the Dirichlet ring
 (i ∈ {0, M} or j ∈ {0, N}) is identically zero for all solver state, matching
 the reference's halo-zero convention. Operators read the ring but only ever
 write the interior.
+
+Every op is polymorphic in leading batch dimensions: state arrays may carry
+any number of leading axes (the batched multi-RHS driver,
+``solvers.batched``, stacks B right-hand sides as (B, M+1, N+1)), while the
+coefficient fields a/b/d stay unbatched and broadcast — the operator is
+shared across the batch, which is the whole point of batching (one traced
+program, one coefficient load, B solves). Reductions (``dot_weighted`` and
+friends) reduce ONLY the trailing grid axes, so they are per-member sums, and
+on an unbatched 2D grid they lower to the identical full reduce as before.
 
 These pure-JAX ops are the framework's *reference implementation* — the role
 stage4's retained CPU fallbacks played (SURVEY §7.5); fused Pallas TPU kernels
@@ -25,13 +34,15 @@ import jax.numpy as jnp
 
 
 def interior(u):
-    """Interior view u[1:-1, 1:-1] (unknowns i=1..M-1, j=1..N-1)."""
-    return u[1:-1, 1:-1]
+    """Interior view u[…, 1:-1, 1:-1] (unknowns i=1..M-1, j=1..N-1)."""
+    return u[..., 1:-1, 1:-1]
 
 
 def pad_interior(u_int):
-    """Embed an (M-1, N-1) interior block into the zero Dirichlet ring."""
-    return jnp.pad(u_int, 1)
+    """Embed a (…, M-1, N-1) interior block into the zero Dirichlet ring
+    (leading batch axes, if any, are left untouched)."""
+    pad = [(0, 0)] * (u_int.ndim - 2) + [(1, 1), (1, 1)]
+    return jnp.pad(u_int, pad)
 
 
 def apply_A(w, a, b, h1: float, h2: float):
@@ -39,15 +50,18 @@ def apply_A(w, a, b, h1: float, h2: float):
 
     (Aw)ij = −[a_{i+1,j}(w_{i+1,j}−w_ij) − a_ij(w_ij−w_{i−1,j})]/h1²
              −[b_{i,j+1}(w_{i,j+1}−w_ij) − b_ij(w_ij−w_{i,j−1})]/h2²
-    (``stage0/Withoutopenmp1.cpp:75-88``).
+    (``stage0/Withoutopenmp1.cpp:75-88``). ``w`` may carry leading batch
+    axes; a/b stay (M+1, N+1) and broadcast.
     """
-    wc = w[1:-1, 1:-1]
-    ax = (a[2:, 1:-1] * (w[2:, 1:-1] - wc) - a[1:-1, 1:-1] * (wc - w[:-2, 1:-1])) / (
-        h1 * h1
-    )
-    ay = (b[1:-1, 2:] * (w[1:-1, 2:] - wc) - b[1:-1, 1:-1] * (wc - w[1:-1, :-2])) / (
-        h2 * h2
-    )
+    wc = w[..., 1:-1, 1:-1]
+    ax = (
+        a[2:, 1:-1] * (w[..., 2:, 1:-1] - wc)
+        - a[1:-1, 1:-1] * (wc - w[..., :-2, 1:-1])
+    ) / (h1 * h1)
+    ay = (
+        b[1:-1, 2:] * (w[..., 1:-1, 2:] - wc)
+        - b[1:-1, 1:-1] * (wc - w[..., 1:-1, :-2])
+    ) / (h2 * h2)
     return pad_interior(-(ax + ay))
 
 
@@ -63,7 +77,8 @@ def diag_D(a, b, h1: float, h2: float):
 def apply_Dinv(r, d):
     """z = D⁻¹ r with a precomputed interior diagonal ``d`` (z=0 where D==0,
     ``stage0/Withoutopenmp1.cpp:100``; D > 0 always holds here since a,b ≥ 1,
-    the guard is kept for parity).
+    the guard is kept for parity). ``r`` may carry leading batch axes; ``d``
+    stays (M-1, N-1) and broadcasts.
 
     The reference recomputes D from a, b on every call
     (``stage0/Withoutopenmp1.cpp:91-103``, ``stage4:…cu:541-562`` — its
@@ -72,11 +87,16 @@ def apply_Dinv(r, d):
     (rather than a hoisted reciprocal multiply) is kept so fp64 results match
     the reference bit-for-bit.
     """
-    z = jnp.where(d != 0.0, r[1:-1, 1:-1] / jnp.where(d != 0.0, d, 1.0), 0.0)
+    z = jnp.where(
+        d != 0.0, r[..., 1:-1, 1:-1] / jnp.where(d != 0.0, d, 1.0), 0.0
+    )
     return pad_interior(z)
 
 
 def dot_weighted(u, v, h1: float, h2: float):
-    """Weighted inner product h1·h2·Σ_interior u·v
-    (``stage0/Withoutopenmp1.cpp:64-72``)."""
-    return jnp.sum(u[1:-1, 1:-1] * v[1:-1, 1:-1]) * (h1 * h2)
+    """Weighted inner product h1·h2·Σ_interior u·v, reduced per batch member
+    (``stage0/Withoutopenmp1.cpp:64-72``): scalar for 2D grids, shape (…,)
+    for batched stacks — the trailing grid axes are always the ones summed."""
+    return jnp.sum(
+        u[..., 1:-1, 1:-1] * v[..., 1:-1, 1:-1], axis=(-2, -1)
+    ) * (h1 * h2)

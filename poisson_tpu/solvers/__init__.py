@@ -1,7 +1,13 @@
 from poisson_tpu.solvers.adjoint import differentiable_solve
+from poisson_tpu.solvers.batched import solve_batched
 from poisson_tpu.solvers.checkpoint import pcg_solve_checkpointed
 from poisson_tpu.solvers.history import pcg_solve_history
-from poisson_tpu.solvers.pcg import PCGResult, pcg_solve, pcg_step_fn
+from poisson_tpu.solvers.pcg import (
+    PCGResult,
+    iterations_scalar,
+    pcg_solve,
+    pcg_step_fn,
+)
 from poisson_tpu.solvers.refine import RefineResult, refined_solve
 from poisson_tpu.solvers.resilient import (
     DivergenceError,
@@ -15,10 +21,12 @@ __all__ = [
     "RecoveryPolicy",
     "RefineResult",
     "differentiable_solve",
+    "iterations_scalar",
     "pcg_solve",
     "pcg_solve_checkpointed",
     "pcg_solve_history",
     "pcg_solve_resilient",
     "pcg_step_fn",
     "refined_solve",
+    "solve_batched",
 ]

@@ -1,0 +1,326 @@
+"""Batched multi-RHS solves: one traced program, many Poisson problems.
+
+Every path in the framework — like all five reference implementations
+(SURVEY §0) — solved exactly one right-hand side per dispatch. This driver
+applies the block-CG insight (O'Leary 1980, PAPERS.md) as a *hardware
+batching* transform rather than a Krylov-subspace change: the operator is
+identical across members, so B right-hand sides stack on a leading batch
+axis and the shared PCG body (``solvers.pcg.make_pcg_body``) is ``vmap``-ed
+over it. One compile, one ``lax.while_loop``, one kernel launch sequence —
+compile time, dispatch overhead, and coefficient-field memory traffic are
+paid once for the whole batch, the same throughput move every inference
+serving stack makes (Orca, PAPERS.md).
+
+Per-member convergence masking keeps the iterate sequences honest: each
+member carries its own ``flag``/``k``, a member that stops (converged,
+breakdown, non-finite, budget) is *frozen* — the vmapped body still computes
+its would-be update, a per-member select discards it — and the fused loop
+exits when every member has stopped. A member's iterates, flags, and
+iteration counts therefore match the sequential ``pcg_loop`` bit-for-bit
+(tests/test_batched.py asserts exactly this, f32 and f64).
+
+Ragged request sets are padded to a bucket size so one compiled executable
+serves many batch sizes: a zero RHS converges degenerately at iteration 1
+(ζ₀ = 0 trips the |（Ap,p)| guard), so padding members cost one masked
+iteration and are sliced off before returning. Bucket-cache reuse is
+surfaced via ``obs.metrics`` (``batched.bucket_cache.hits``/``.misses``).
+
+Composition with the sharded path: the batch axis would have to be vmapped
+*outside* ``shard_map`` (members stay whole-grid; the mesh splits the grid,
+not the batch). That wiring does not exist yet, so a ``mesh`` argument is
+explicitly rejected with a clear error instead of silently mis-sharding.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from poisson_tpu import obs
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers.pcg import (
+    PCGOps,
+    PCGResult,
+    PCGState,
+    host_setup,
+    init_state,
+    make_pcg_body,
+    resolve_dtype,
+    resolve_scaled,
+    scaled_single_device_ops,
+    single_device_ops,
+)
+
+# Bucket ladder for padding ragged batch sizes onto a small set of compiled
+# executables. Powers of two up to 256: request sets beyond the top bucket
+# compile at their exact size (a deliberate escape hatch, not an error).
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+# Shapes this process has already traced, keyed like the jit cache
+# ((bucket, M, N, dtype, scaled, weighted, delta, cap)). Mirrors XLA's own
+# compile cache so the hit/miss counters in obs.metrics tell the serving
+# story (a ragged arrival pattern that buckets well shows hits >> misses).
+_TRACED: set = set()
+
+
+def reset_bucket_cache() -> None:
+    """Forget which bucket shapes this process has traced (tests; a
+    library user pairing it with ``obs.metrics.reset()`` — the counters
+    and this set must move together or hit/miss arithmetic goes stale)."""
+    _TRACED.clear()
+
+
+def bucket_size(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    """Smallest bucket ≥ n (n itself beyond the ladder)."""
+    if n < 1:
+        raise ValueError(f"batch size must be >= 1, got {n}")
+    for b in buckets:
+        if n <= b:
+            return int(b)
+    return int(n)
+
+
+def pcg_loop_batched(ops: PCGOps, rhs_stack, *, delta: float, max_iter: int,
+                     weighted_norm: bool, h1: float, h2: float,
+                     stagnation_window: int = 0) -> PCGState:
+    """Run the shared PCG body over a (B, M+1, N+1) RHS stack in ONE fused
+    ``while_loop`` with per-member convergence masking.
+
+    The body is the exact sequential body (``make_pcg_body``) vmapped over
+    the batch axis; each iteration then freezes every member whose previous
+    state was already stopped (done, or at the iteration cap) by selecting
+    its old state over the computed update — so a member's trajectory is
+    identical to what ``pcg_loop`` would have produced, including its
+    final ``k`` and ``flag``. The loop exits when no member can advance.
+
+    Streaming (``stream_every``) is deliberately not plumbed here: the
+    host callback is per-iteration scalar telemetry and has no meaningful
+    vmapped form; the batched path reports per-member outcomes instead.
+    """
+    body = make_pcg_body(
+        ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
+        stagnation_window=stagnation_window,
+    )
+    vbody = jax.vmap(body)
+    init = jax.vmap(functools.partial(init_state, ops))(rhs_stack)
+
+    def masked_body(s: PCGState) -> PCGState:
+        stepped = vbody(s)
+        frozen = s.done | (s.k >= max_iter)
+
+        def keep(old, new):
+            pred = frozen.reshape(frozen.shape + (1,) * (new.ndim - 1))
+            return jnp.where(pred, old, new)
+
+        return jax.tree_util.tree_map(keep, s, stepped)
+
+    def cond(s: PCGState):
+        return jnp.any((~s.done) & (s.k < max_iter))
+
+    return lax.while_loop(cond, masked_body, init)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _solve_batched(problem: Problem, scaled: bool, a, b, rhs_stack,
+                   aux) -> PCGResult:
+    """jitted batched solve over a (B, M+1, N+1) RHS stack; compiled once
+    per (bucket, grid, dtype, scaled) — the executable every padded
+    request set of the same bucket reuses."""
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    s = pcg_loop_batched(
+        ops, rhs_stack,
+        delta=problem.delta, max_iter=problem.iteration_cap,
+        weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+    w = s.w * aux if scaled else s.w   # aux broadcasts over the batch axis
+    return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr,
+                     flag=s.flag, max_iterations=jnp.max(s.k))
+
+
+def _shared_base(problems: Sequence[Problem]) -> Problem:
+    """Validate that every member shares the operator (everything except
+    the RHS magnitude ``f_val``) and return the shared base problem."""
+    if not problems:
+        raise ValueError("solve_batched needs at least one problem")
+    base = problems[0]
+    for i, p in enumerate(problems[1:], start=1):
+        if p.with_(f_val=base.f_val) != base:
+            raise ValueError(
+                "batched members must share the operator — every Problem "
+                "field except f_val must match member 0; member "
+                f"{i} differs: {p} vs {base}"
+            )
+    return base
+
+
+def _count_bucket(key: tuple, batch: int, bucket: int) -> None:
+    if key in _TRACED:
+        obs.inc("batched.bucket_cache.hits")
+    else:
+        _TRACED.add(key)
+        obs.inc("batched.bucket_cache.misses")
+    obs.inc("batched.solves", batch)
+    obs.inc("batched.padding_members", bucket - batch)
+    obs.gauge("batched.last_bucket", bucket)
+
+
+def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
+                  dtype=None, scaled=None, mesh=None,
+                  buckets: Sequence[int] = DEFAULT_BUCKETS,
+                  bucket: Optional[int] = None) -> PCGResult:
+    """Solve a batch of Poisson problems in one fused device program.
+
+    Input forms (exactly one):
+
+    - ``solve_batched([p0, p1, …])`` — a sequence of :class:`Problem`
+      sharing everything but ``f_val`` (the operator must be shared; the
+      RHS may differ member-to-member). Each member's RHS is built by the
+      same fp64 host setup the sequential solver uses, so member ``i``
+      reproduces ``pcg_solve(p_i)`` bit-for-bit.
+    - ``solve_batched(p, rhs_gates=[g0, g1, …])`` — one problem, B scalar
+      RHS multipliers (the batched mirror of ``pcg_solve``'s ``rhs_gate``;
+      also the bench/CLI chaining hook — gates may be traced scalars).
+    - ``solve_batched(p, rhs_stack=B_array)`` — one problem, an explicit
+      (B, M+1, N+1) stack of physical right-hand sides (zero Dirichlet
+      ring; internally mapped to the scaled system when ``scaled``).
+
+    The batch is zero-padded to :func:`bucket_size` (``bucket`` pins an
+    explicit size ≥ B) so ragged request sets reuse one compiled
+    executable per bucket; padding members stop degenerately at iteration
+    1 and are sliced off before returning. Returns a :class:`PCGResult`
+    whose ``w``/``iterations``/``diff``/``residual_dot``/``flag`` carry a
+    leading batch axis (``iterations`` is the per-member truth) plus the
+    scalar ``max_iterations`` the fused loop actually ran.
+
+    ``dtype``/``scaled`` follow ``pcg_solve``'s precision policy. ``mesh``
+    is rejected: the batch axis must be vmapped OUTSIDE ``shard_map``, and
+    that composition is not wired up yet.
+    """
+    if mesh is not None:
+        raise ValueError(
+            "solve_batched does not compose with a device mesh yet: the "
+            "batch axis must be vmapped OUTSIDE shard_map (members stay "
+            "whole-grid; the mesh splits the grid, not the batch). Run "
+            "solve_batched on a single device, or solve members "
+            "individually with parallel.pcg_solve_sharded."
+        )
+    forms = sum(x is not None for x in (rhs_stack, rhs_gates))
+    if problems is None:
+        raise ValueError("solve_batched needs problems (a Problem or a "
+                         "sequence of Problems)")
+    if isinstance(problems, Problem):
+        problem = problems
+        if forms != 1:
+            raise ValueError(
+                "with a single Problem, pass exactly one of rhs_gates or "
+                "rhs_stack (a sequence of Problems is the third form)"
+            )
+        member_problems = None
+    else:
+        if forms != 0:
+            raise ValueError(
+                "rhs_gates/rhs_stack apply to the single-Problem form; a "
+                "sequence of Problems already defines every member's RHS"
+            )
+        member_problems = list(problems)
+        problem = _shared_base(member_problems)
+
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+
+    # f_val never enters the traced program (the RHS arrives as a traced
+    # array; the jitted solve reads only delta/cap/norm/h1/h2), so the jit
+    # static key — and the bucket-cache key that mirrors it — normalizes
+    # it away: batches differing only in RHS magnitude share one compiled
+    # executable per bucket.
+    jit_problem = problem.with_(f_val=1.0)
+    if member_problems is not None:
+        from poisson_tpu.solvers.pcg import host_fields64
+
+        # One shared setup (a/b/aux are f_val-independent) plus per-member
+        # RHS by exact fp64 scaling of the unit-f_val base — NOT B full
+        # host setups (which would also thrash host_setup's small LRU).
+        # Bit-exactness vs host_setup(p_i): the indicator is 0/1 and the
+        # scaling is a single fp64 product either way (f·1[D]·D^{-1/2}
+        # associates without extra roundings), then the same cast.
+        a, b, _, aux = host_setup(jit_problem, dtype_name, use_scaled)
+        base64 = host_fields64(jit_problem, use_scaled)[2]
+        dt = jnp.dtype(dtype_name)
+        rhs_stack = jnp.stack([jnp.asarray(base64 * p.f_val, dt)
+                               for p in member_problems])
+        batch = len(member_problems)
+    elif rhs_gates is not None:
+        a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+        if hasattr(rhs_gates, "ndim"):
+            # An existing (B,) array — possibly data-dependent on a prior
+            # result (the bench's chaining trick: gates of exactly 1.0
+            # computed from the previous solve serialize back-to-back
+            # batched solves without changing any bit).
+            gates = jnp.asarray(rhs_gates, rhs.dtype).reshape(-1)
+        else:
+            gates = jnp.stack([jnp.asarray(g, rhs.dtype).reshape(())
+                               for g in rhs_gates])
+        batch = gates.shape[0]
+        if batch < 1:
+            raise ValueError("rhs_gates must have at least one member")
+        # Per-member rhs * gate — elementwise, exactly pcg_solve's
+        # rhs_gate multiply, so gated members stay bit-identical to the
+        # sequential gated solve.
+        rhs_stack = rhs[None] * gates[:, None, None]
+    else:
+        a, b, _, aux = host_setup(jit_problem, dtype_name, use_scaled)
+        rhs_stack = jnp.asarray(rhs_stack, jnp.dtype(dtype_name))
+        if rhs_stack.ndim != 3 or rhs_stack.shape[1:] != problem.grid_shape:
+            raise ValueError(
+                f"rhs_stack must be (B, {problem.grid_shape[0]}, "
+                f"{problem.grid_shape[1]}), got {rhs_stack.shape}"
+            )
+        batch = rhs_stack.shape[0]
+        if use_scaled:
+            # Physical B → scaled b̃ = D^{-1/2}·B; aux IS D^{-1/2} on the
+            # full grid (zero ring), so one broadcast multiply.
+            rhs_stack = rhs_stack * aux
+
+    size = bucket_size(batch, buckets) if bucket is None else int(bucket)
+    if size < batch:
+        raise ValueError(f"bucket {size} smaller than batch {batch}")
+    if size > batch:
+        pad = jnp.zeros((size - batch,) + tuple(rhs_stack.shape[1:]),
+                        rhs_stack.dtype)
+        rhs_stack = jnp.concatenate([rhs_stack, pad])
+
+    # Keyed exactly like the jit call below ((static problem, scaled) +
+    # the shapes/dtype the stacked operands carry), so the hit/miss
+    # counters report real executable reuse, not an approximation of it.
+    key = (size, jit_problem, dtype_name, use_scaled)
+    _count_bucket(key, batch, size)
+
+    result = _solve_batched(jit_problem, use_scaled, a, b, rhs_stack, aux)
+    if size == batch:
+        return result
+    # Slice padding members off every batched field; max_iterations is
+    # recomputed over the real members (padding stops at k=1, so the
+    # fused-loop max is unchanged unless every member was padding).
+    return PCGResult(
+        w=result.w[:batch],
+        iterations=result.iterations[:batch],
+        diff=result.diff[:batch],
+        residual_dot=result.residual_dot[:batch],
+        flag=result.flag[:batch],
+        max_iterations=jnp.max(result.iterations[:batch]),
+    )
+
+
+# Smoke check: ``python -m poisson_tpu.solvers.batched_selfcheck`` (its own
+# module so runpy never re-executes this one, which the package __init__
+# already imports).

@@ -106,8 +106,15 @@ class PCGState(NamedTuple):
 
 
 class PCGResult(NamedTuple):
-    w: jnp.ndarray           # full (M+1, N+1) solution grid
-    iterations: jnp.ndarray
+    """Solve result. Scalar solves fill the historical scalar fields; the
+    batched driver (``solvers.batched``) returns the SAME type with a
+    leading batch axis on ``w``/``iterations``/``diff``/``residual_dot``/
+    ``flag`` — ``iterations`` is then the per-member truth (a vector), and
+    ``max_iterations`` carries the scalar the wall clock actually paid for
+    (the fused loop runs until the slowest member stops)."""
+
+    w: jnp.ndarray           # full (…, M+1, N+1) solution grid(s)
+    iterations: jnp.ndarray  # per-solve count; vector on batched results
     diff: jnp.ndarray        # final update norm
     residual_dot: jnp.ndarray  # final ζ = (D⁻¹r, r)
     flag: jnp.ndarray = np.int32(FLAG_NONE)  # termination verdict (FLAG_*)
@@ -117,6 +124,18 @@ class PCGResult(NamedTuple):
     # recovered and then converged is no longer silent about it.
     restarts: object = None            # int: recovery attempts taken
     recovery_history: tuple = ()       # ((iteration, verdict, action), …)
+    # Batched solves only: scalar max over the member iteration vector
+    # (None on scalar solves, an empty pytree node under jit).
+    max_iterations: object = None
+
+
+def iterations_scalar(iterations) -> int:
+    """Collapse an ``iterations`` field to one honest scalar: the value
+    itself for scalar solves, the max over members for batched vectors —
+    the iteration count the fused loop actually ran (and the wall clock
+    paid for), which is what every report line historically meant."""
+    arr = np.asarray(iterations)
+    return int(arr.max()) if arr.ndim else int(arr)
 
 
 def _select(pred, new, old):
@@ -259,14 +278,21 @@ def single_device_ops(problem: Problem, a, b, aux) -> PCGOps:
 
     ``aux`` is the Jacobi diagonal embedded in the full grid's zero ring —
     the same full-grid layout ``scaled_single_device_ops`` takes, so both
-    backends consume :func:`host_setup`'s aux unchanged."""
+    backends consume :func:`host_setup`'s aux unchanged.
+
+    Every op accepts leading batch axes (the ``ops.stencil`` convention):
+    reductions sum only the trailing grid axes, so a (B, M+1, N+1) state
+    stack gets per-member dots/norms — usable either directly or under
+    ``vmap`` (the batched driver, ``solvers.batched``)."""
     h1, h2 = problem.h1, problem.h2
     d = aux[1:-1, 1:-1]
     return PCGOps(
         apply_A=lambda p: apply_A(p, a, b, h1, h2),
         apply_Dinv=lambda r: apply_Dinv(r, d),
         dot=lambda u, v: dot_weighted(u, v, h1, h2),
-        sqnorm=lambda u: jnp.sum(u[1:-1, 1:-1] * u[1:-1, 1:-1]),
+        sqnorm=lambda u: jnp.sum(
+            u[..., 1:-1, 1:-1] * u[..., 1:-1, 1:-1], axis=(-2, -1)
+        ),
         exchange=lambda p: p,
     )
 
@@ -284,13 +310,16 @@ def scaled_single_device_ops(problem: Problem, a, b, sc) -> PCGOps:
     ``sc`` is D^{-1/2} on the full grid (zero ring). The preconditioner
     becomes the identity; the convergence norm is mapped back to w-space via
     ‖Δw‖ = ‖sc·Δy‖; the caller maps the solution back with w = sc·y.
+    Batch-polymorphic like :func:`single_device_ops` (sc broadcasts over
+    leading axes; reductions are per-member).
     """
     h1, h2 = problem.h1, problem.h2
     return PCGOps(
         apply_A=lambda p: apply_A(p * sc, a, b, h1, h2) * sc,
         apply_Dinv=lambda r: r,
         dot=lambda u, v: dot_weighted(u, v, h1, h2),
-        sqnorm=lambda u: jnp.sum((u * sc)[1:-1, 1:-1] ** 2),
+        sqnorm=lambda u: jnp.sum((u * sc)[..., 1:-1, 1:-1] ** 2,
+                                 axis=(-2, -1)),
         exchange=lambda p: p,
     )
 

@@ -52,6 +52,7 @@ from poisson_tpu.solvers.pcg import (
     PCGResult,
     host_setup,
     init_state,
+    iterations_scalar,
     restart_state,
     resolve_dtype,
     resolve_scaled,
@@ -181,15 +182,18 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
     chunks_done = 0
 
     def diagnostics(flag: int) -> dict:
+        # iterations_scalar / jnp.max: format scalar AND per-member-vector
+        # states (a batched result fed back through this driver's reporting
+        # must degrade to the honest max, not crash the post-mortem).
         return {
             "problem": f"{problem.M}x{problem.N}",
             "verdict": FLAG_NAMES.get(flag, str(flag)),
-            "iteration": int(state.k),
+            "iteration": iterations_scalar(state.k),
             "dtype": dtype_name,
             "restarts": restarts,
             "history": list(history),
-            "diff": float(state.diff),
-            "residual_dot": float(state.zr),
+            "diff": float(jnp.max(state.diff)),
+            "residual_dot": float(jnp.max(state.zr)),
         }
 
     if watchdog is not None:
@@ -240,7 +244,8 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                 diag = diagnostics(flag)
                 raise DivergenceError(
                     f"solve failed ({FLAG_NAMES.get(flag, flag)} at "
-                    f"iteration {int(state.k)}, dtype {dtype_name}) and "
+                    f"iteration {iterations_scalar(state.k)}, "
+                    f"dtype {dtype_name}) and "
                     f"the recovery budget ({policy.max_restarts} restarts) "
                     f"is exhausted",
                     diagnostics=diag,
@@ -270,7 +275,7 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                       from_iteration=last_good[1])
             warnings.warn(
                 f"solve {FLAG_NAMES.get(flag, str(flag))} at iteration "
-                f"{int(state.k)}; {action} from last good iterate "
+                f"{iterations_scalar(state.k)}; {action} from last good iterate "
                 f"(iteration {last_good[1]})",
                 RuntimeWarning, stacklevel=2,
             )

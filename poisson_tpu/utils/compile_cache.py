@@ -1,0 +1,91 @@
+"""JAX persistent compilation cache, env-driven, with hit/miss counters.
+
+Compile time is the dominant fixed cost of every cold start in this stack
+(the flagship solve compiles in seconds; the solve itself runs in under
+one) — and the batched driver multiplies the stakes: one bucket executable
+serves hundreds of solves, so persisting it across processes turns every
+warm start into pure execute time. ``POISSON_TPU_COMPILE_CACHE=<dir>``
+points JAX's persistent compilation cache at ``<dir>``; both entry points
+(``poisson_tpu.cli`` and ``bench.py``) call :func:`enable_from_env` before
+their first trace.
+
+Cache traffic is surfaced through the unified telemetry counters
+(``obs.metrics``): JAX publishes ``/jax/compilation_cache/cache_hits`` /
+``…/cache_misses`` on its ``jax.monitoring`` bus, and the listener
+registered here folds them into ``compile_cache.hits`` /
+``compile_cache.misses`` — landing in the same snapshot as
+``time.compile_seconds``, so a metrics file alone answers "did this run
+pay for its compiles or reuse them?".
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_VAR = "POISSON_TPU_COMPILE_CACHE"
+
+_LISTENER_INSTALLED = False
+
+# jax.monitoring event names → our counter names (low cardinality, dotted —
+# the obs.metrics convention).
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/cache_hits": "compile_cache.hits",
+    "/jax/compilation_cache/cache_misses": "compile_cache.misses",
+}
+
+
+def _listener(event: str, **kwargs) -> None:
+    name = _EVENT_COUNTERS.get(event)
+    if name is not None:
+        from poisson_tpu.obs import metrics
+
+        metrics.inc(name)
+
+
+def install_counters() -> bool:
+    """Register the monitoring listener (idempotent). Separate from
+    :func:`enable_from_env` so tests can exercise the counter wiring
+    without touching the process-wide cache config. Returns False when
+    this JAX build has no monitoring bus."""
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    monitoring.register_event_listener(_listener)
+    _LISTENER_INSTALLED = True
+    return True
+
+
+def enable_from_env() -> bool:
+    """Enable the persistent compilation cache when ``ENV_VAR`` is set.
+
+    Points ``jax_compilation_cache_dir`` at the directory (created if
+    missing) and zeroes the persistence thresholds so even the small/fast
+    programs this stack compiles are persisted (the defaults skip entries
+    below a minimum size and compile time). Installs the hit/miss
+    counters whenever the env var is set, even if the config update then
+    fails (the counters are how that failure gets noticed). Returns True
+    iff the cache was enabled; unset env or a failing config update (an
+    exotic JAX build) degrades to False, never to an exception — a cache
+    problem must not take the solve down.
+    """
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return False
+    import jax
+
+    install_counters()
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        return False
+    from poisson_tpu.obs import metrics
+
+    metrics.gauge("compile_cache.dir", path)
+    return True

@@ -129,6 +129,10 @@ class SolveReport:
     # not only inside DivergenceError.
     restarts: Optional[int] = None
     recovery: Optional[tuple] = None
+    # Batched solves: batch size and the per-member iteration vector
+    # (``iterations`` above then holds the scalar max the fused loop ran).
+    batch: Optional[int] = None
+    iterations_per_member: Optional[list] = None
 
     def json_line(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -136,7 +140,8 @@ class SolveReport:
     def table(self) -> str:
         rows = [
             f"M={self.M}, N={self.N} | Iter={self.iterations} "
-            f"| Time={self.solve_seconds:.4f} s",
+            + (f"(max of {self.batch} members) " if self.batch else "")
+            + f"| Time={self.solve_seconds:.4f} s",
             f"  compile: {self.compile_seconds:.2f} s   dtype: {self.dtype}"
             f"   devices: {self.devices}"
             + (f"   mesh: {self.mesh[0]}x{self.mesh[1]}" if self.mesh else "")
@@ -177,9 +182,17 @@ def solve_report(
     backend: Optional[str] = None,
     device_kind: Optional[str] = None,
 ) -> SolveReport:
-    from poisson_tpu import obs
+    import numpy as np
 
-    iters = int(result.iterations)
+    from poisson_tpu import obs
+    from poisson_tpu.solvers.pcg import iterations_scalar
+
+    # Batched results carry per-member vectors; the report's scalar slots
+    # hold the honest wall-clock values (the fused loop's max) and the
+    # per-member vector rides alongside.
+    iters_arr = np.asarray(result.iterations)
+    batched = iters_arr.ndim > 0
+    iters = iterations_scalar(result.iterations)
     # Verdict-tracking solvers (PCGResult.flag) surface abnormal stops in
     # the report; converged/untracked results stay quiet.
     stopped = None
@@ -189,7 +202,20 @@ def solve_report(
         from poisson_tpu.solvers.pcg import FLAG_CONVERGED, FLAG_NAMES, \
             FLAG_NONE
 
-        flag = int(flag)
+        # Vector flags: the worst member wins, by severity — failure
+        # verdicts (breakdown/nonfinite/stagnated) first, then
+        # done-without-verdict (FLAG_NONE, e.g. a budget-exhausted
+        # member), then converged. A plain max() would rank FLAG_NONE (0)
+        # below FLAG_CONVERGED (1) and report a cap-hit batch as
+        # converged.
+        flags = np.asarray(flag).ravel()
+        failures = flags[(flags != FLAG_NONE) & (flags != FLAG_CONVERGED)]
+        if failures.size:
+            flag = int(failures.max())
+        elif (flags == FLAG_NONE).any():
+            flag = FLAG_NONE
+        else:
+            flag = int(flags.max()) if flags.size else FLAG_NONE
         flag_name = FLAG_NAMES.get(flag, str(flag))
         if flag == FLAG_NONE:
             # done-without-verdict (cap hit, or a verdict-less solver
@@ -211,8 +237,18 @@ def solve_report(
         iterations=iters,
         solve_seconds=solve_seconds,
         compile_seconds=compile_seconds,
-        mlups=mlups(problem, iters, solve_seconds),
-        final_diff=float(result.diff),
+        # Batched: throughput counts every member's useful updates
+        # (Σ member iterations), not just the slowest member's — a B=64
+        # batch's MLUPS must be comparable with B=64 sequential reports,
+        # not ~64× under them.
+        mlups=mlups(problem,
+                    int(iters_arr.sum()) if batched else iters,
+                    solve_seconds),
+        final_diff=float(np.max(np.asarray(result.diff))),
+        batch=(int(iters_arr.shape[0]) if batched else None),
+        iterations_per_member=(
+            [int(k) for k in iters_arr] if batched else None
+        ),
         dtype=dtype,
         devices=devices,
         mesh=mesh,

@@ -51,6 +51,12 @@ def pytest_configure(config):
         "obs: unified-telemetry suite (spans/counters/streaming; "
         "CPU-fast; runs in tier-1, selectable with -m obs)",
     )
+    config.addinivalue_line(
+        "markers",
+        "batched: batched multi-RHS driver suite (batch-vs-sequential "
+        "bit-parity, bucketing, CLI/bench throughput mode; CPU-fast; "
+        "runs in tier-1, selectable with -m batched)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
