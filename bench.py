@@ -15,6 +15,16 @@ must — the batched loop is the same body, masked).
 Both modes honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the persistent JAX
 compilation cache; hits/misses are counted in the metrics snapshot).
 
+Every record carries performance-attribution provenance: a ``costs``
+block (compiled-iteration FLOPs/bytes vs the analytic stencil model,
+plus the achieved-vs-roofline fraction — ``poisson_tpu.obs.costs``) and
+a ``platform_fallback`` bit in the detail, so the regression sentinel
+(``benchmarks/regress.py``) can tell a tunnel outage from a slowdown.
+Backend-probe failures land on the ``bench.backend_probe.failures``
+counter and as telemetry events, not just stderr. Set
+``POISSON_TPU_PROFILE_DIR`` to capture a device-timeline profile of one
+extra (untimed) solve.
+
 Baseline: the reference's stage4 MPI+CUDA single-GPU (Tesla P100) result on
 the same 800×1200 grid — 989 iterations in 0.83 s ⇒ ≈1141 MLUPS
 (BASELINE.md, Этап_4_1213.pdf Table 1). vs_baseline = ours / 1141.
@@ -65,7 +75,7 @@ GOLDEN_ITERS = {
 K_LO, K_HI = 1, 6
 
 
-def _acquire_backend() -> bool:
+def _acquire_backend() -> tuple[bool, list[dict]]:
     """Decide the platform BEFORE importing jax in this process.
 
     The ambient backend may be a tunneled remote accelerator whose device
@@ -75,18 +85,25 @@ def _acquire_backend() -> bool:
     process to the CPU platform — the harness always gets a JSON line,
     with ``platform`` recording what actually ran.
 
-    Returns True iff the ambient backend failed its probes and the run was
-    downgraded (as opposed to a deliberate CPU run) — the provenance bit
-    the emitted JSON uses to say WHY a non-TPU platform ran.
+    Returns ``(downgraded, probe_failures)``: ``downgraded`` is True iff
+    the ambient backend failed its probes and the run was downgraded (as
+    opposed to a deliberate CPU run) — the provenance bit the emitted
+    JSON carries as ``platform_fallback`` so the regression sentinel
+    (benchmarks/regress.py) can tell a tunnel outage from a slowdown.
+    ``probe_failures`` holds one detail dict per failed probe; main()
+    replays them into obs.metrics/events once telemetry is up (the
+    probes run before the obs import on purpose — nothing may touch jax
+    before the platform is pinned).
     """
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
-        return False  # deliberately pinned to the host platform
+        return False, []  # deliberately pinned to the host platform
     probe = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
     # Healthy tunnel init is ~10-30 s; 60 s probes × 5 with short backoffs
     # keep the worst case under ~6 min of a ~10 min budget while giving a
     # transient wedge five chances to clear (round-2: 3×120 s left none).
     attempts = int(os.environ.get("BENCH_BACKEND_ATTEMPTS", "5"))
     timeout = float(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", "60"))
+    failures: list[dict] = []
     for i in range(attempts):
         try:
             proc = subprocess.run(
@@ -97,11 +114,13 @@ def _acquire_backend() -> bool:
                 timeout=timeout,
             )
             if proc.returncode == 0 and proc.stdout.strip():
-                return False  # ambient backend is healthy; use it as-is
+                return False, failures  # ambient backend healthy; use it
             detail = proc.stderr.strip().splitlines()
             detail = detail[-1] if detail else f"rc={proc.returncode}"
         except subprocess.TimeoutExpired:
             detail = f"device init hung >{timeout:.0f}s"
+        failures.append({"attempt": i + 1, "attempts": attempts,
+                         "detail": str(detail)[:300]})
         print(
             f"bench: backend probe {i + 1}/{attempts} failed ({detail})",
             file=sys.stderr,
@@ -110,7 +129,7 @@ def _acquire_backend() -> bool:
             time.sleep(min(30.0, 5.0 * (i + 1)))
     print("bench: falling back to the CPU platform", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
-    return True
+    return True, failures
 
 
 # The reference's published grids (BASELINE.md Table 1): each gets its
@@ -213,7 +232,8 @@ def _adopt_layout_decision() -> None:
               file=sys.stderr)
 
 
-def _batched_bench(problem, batch: int, devices, platform: str) -> int:
+def _batched_bench(problem, batch: int, devices, platform: str,
+                   downgraded: bool = False) -> int:
     """Throughput mode: B solves per fused dispatch vs B sequential solves.
 
     Same slope methodology as the headline bench (chained data-dependent
@@ -315,8 +335,27 @@ def _batched_bench(problem, batch: int, devices, platform: str) -> int:
             "devices": 1,
             "platform": platform,
             "device_kind": getattr(devices[0], "device_kind", None),
+            # Provenance for the regression sentinel: True means the
+            # ambient accelerator failed its probes and this run was
+            # downgraded — a tunnel outage fingerprint, not a slowdown.
+            "platform_fallback": downgraded,
         },
     }
+    from poisson_tpu.obs import costs as obs_costs
+
+    cost_block = obs_costs.bench_costs(
+        problem, dtype=dtype, backend="xla_batched",
+        iterations=seq_iters * B, solve_seconds=tb,
+        device_kind=record["detail"]["device_kind"],
+    )
+    if cost_block:
+        record["costs"] = cost_block
+    from poisson_tpu.obs import profile as obs_profile
+
+    if obs_profile.enabled():
+        with obs_profile.capture("bench.batched"):
+            fence(solve_batched(problem, rhs_gates=ones,
+                                dtype=dtype).iterations)
     obs.gauge("bench.batched_solves_per_sec", record["value"])
     obs.gauge("bench.batched_speedup", record["speedup_vs_sequential"])
     obs.event("bench.batched", **record["detail"],
@@ -328,17 +367,28 @@ def _batched_bench(problem, batch: int, devices, platform: str) -> int:
 
 
 def main() -> int:
-    downgraded = _acquire_backend()
+    downgraded, probe_failures = _acquire_backend()
     _adopt_layout_decision()
 
     # Unified telemetry, env-driven (argv is the grid contract):
     # POISSON_TPU_TRACE_DIR / POISSON_TPU_METRICS_OUT /
-    # POISSON_TPU_STREAM_EVERY. After the backend probe on purpose — the
-    # poisson_tpu import initializes jax, which must not happen before
-    # the probe pins the platform.
+    # POISSON_TPU_STREAM_EVERY / POISSON_TPU_PROFILE_DIR /
+    # POISSON_TPU_PROM_OUT / POISSON_TPU_METRICS_PORT. After the backend
+    # probe on purpose — the poisson_tpu import initializes jax, which
+    # must not happen before the probe pins the platform.
     from poisson_tpu import obs
 
     obs.configure_from_env()
+
+    # Replay the pre-telemetry probe failures into the registry: stderr
+    # lines alone are invisible to the sentinel and the forensics report.
+    if probe_failures:
+        obs.inc("bench.backend_probe.failures", len(probe_failures))
+        for failure in probe_failures:
+            obs.event("bench.backend_probe_failure", **failure)
+    if downgraded:
+        obs.event("bench.platform_fallback",
+                  probes_failed=len(probe_failures))
 
     import jax
 
@@ -423,7 +473,8 @@ def main() -> int:
     platform = devices[0].platform
 
     if batch is not None:
-        return _batched_bench(problem, batch, devices, platform)
+        return _batched_bench(problem, batch, devices, platform,
+                              downgraded=downgraded)
 
     def xla_run(gate=None):
         if len(devices) > 1:
@@ -612,8 +663,35 @@ def main() -> int:
             # layouts are numerically equivalent but compile differently,
             # so the artifact must say which one set a record.
             "serial_reduce": serial_reduce,
+            # True iff the ambient accelerator failed its probes and the
+            # run was downgraded (vs a deliberate CPU run) — how the
+            # regression sentinel tells a tunnel outage from a slowdown.
+            "platform_fallback": downgraded,
         },
     }
+    # Performance attribution (obs.costs): what this solve SHOULD cost.
+    # One compiled-iteration introspection + the analytic stencil model
+    # + the roofline fraction of the measured run; advisory (None on any
+    # failure, POISSON_TPU_COST_ANALYSIS=0 disables). full_program only
+    # on the xla backend — that is the program that actually ran.
+    from poisson_tpu.obs import costs as obs_costs
+
+    cost_block = obs_costs.bench_costs(
+        problem, dtype=dtype, backend=backend, iterations=iters,
+        solve_seconds=best,
+        device_kind=record["detail"]["device_kind"],
+        devices=len(devices),
+        full_program=(backend == "xla" and len(devices) == 1),
+    )
+    if cost_block:
+        record["costs"] = cost_block
+    # Optional profiler capture of ONE extra solve (POISSON_TPU_PROFILE_DIR)
+    # — after the timed chains so the capture cannot perturb the slope.
+    from poisson_tpu.obs import profile as obs_profile
+
+    if obs_profile.enabled():
+        with obs_profile.capture("bench.solve"):
+            fence(run().iterations)
     flagship = (problem.M, problem.N) == (800, 1200)
     published = (problem.M, problem.N) in _PUBLISHED_GRIDS
     if platform == "tpu" and published:

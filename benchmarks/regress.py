@@ -1,0 +1,315 @@
+"""Regression sentinel: platform-grouped, noise-robust bench verdicts.
+
+The committed BENCH history is exactly the failure mode this gate
+exists for: r01 is a crashed run, r02–r05 are CPU-fallback runs
+(~150–168 MLUPS) from a wedged tunnel, and the stale TPU high-water
+mark says 23,840 MLUPS — naive "is the new number smaller" alerting
+would page on every tunnel outage and miss a real on-chip slowdown
+behind one. So:
+
+1. **Group before comparing.** Records are cohorted by
+   (metric, grid, dtype, platform, backend, devices): a CPU-fallback
+   run is never judged against a TPU baseline, and a pallas record is
+   never judged against an xla one. A non-TPU record that *is* a
+   downgrade (the ``platform_fallback`` bit bench.py now emits, or the
+   fallback fingerprints in older artifacts' stderr tails) is
+   classified ``platform_fallback`` — a tunnel outage, not a slowdown
+   — while still being sanity-checked inside its own platform cohort.
+2. **Noise-robust thresholds.** Within a cohort the baseline is the
+   median of the *other* records and the alarm line is
+   ``median − max(k·1.4826·MAD, rel_tol·median)``: MAD scales with the
+   cohort's real run-to-run noise, the relative floor keeps a
+   two-record cohort (MAD 0) from alarming on timer jitter. Defaults:
+   k=3, rel_tol=0.25 — a genuine 2× slowdown is always over the line,
+   a 5% scheduler wobble never is.
+3. **Machine-readable verdict, nonzero exit.** One JSON document on
+   stdout; exit 1 iff any record classifies as a regression — runnable
+   bare in CI (``python benchmarks/regress.py``) and rendered by
+   ``summarize_session.py --telemetry``'s forensics report.
+
+Stdlib only, no jax import: like the forensics renderer, a post-session
+gate must never risk initializing a backend.
+
+Usage:
+    python benchmarks/regress.py [--root DIR] [--history FILE ...]
+          [--session FILE] [--k F] [--rel-tol F] [--pretty]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from statistics import median
+from typing import Optional
+
+_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+# Stderr fingerprints of a platform downgrade in driver artifacts that
+# predate the explicit platform_fallback record field (BENCH_r02–r05).
+_FALLBACK_TAIL_MARKS = (
+    "falling back to the CPU platform",
+    "tunnel was unreachable",
+)
+
+_METRICS = ("mlups", "batched_solves_per_sec")
+
+
+def _mk_record(source: str, *, value=None, metric=None, platform=None,
+               backend=None, grid=None, dtype=None, devices=None,
+               platform_fallback=False, failed=False,
+               note: Optional[str] = None) -> dict:
+    return {
+        "source": source,
+        "value": value,
+        "metric": metric,
+        "platform": platform,
+        "backend": backend,
+        "grid": list(grid) if grid else None,
+        "dtype": dtype,
+        "devices": devices,
+        "platform_fallback": bool(platform_fallback),
+        "failed": bool(failed),
+        "note": note,
+    }
+
+
+def record_from_result(result: dict, source: str,
+                       fallback_hint: bool = False) -> Optional[dict]:
+    """A bench result line ({"metric": …, "value": …, "detail": …}) as a
+    sentinel record; None when it is not a bench metric."""
+    if not isinstance(result, dict) or result.get("metric") not in _METRICS:
+        return None
+    det = result.get("detail") or {}
+    fallback = bool(det.get("platform_fallback", False)) or fallback_hint \
+        or "last_good_tpu" in result
+    return _mk_record(
+        source,
+        value=result.get("value"),
+        metric=result.get("metric"),
+        platform=det.get("platform"),
+        backend=det.get("backend"),
+        grid=det.get("grid"),
+        dtype=det.get("dtype"),
+        devices=det.get("devices"),
+        platform_fallback=fallback,
+    )
+
+
+def load_driver_artifact(path) -> list[dict]:
+    """One BENCH_rNN.json driver snapshot ({n, cmd, rc, tail, parsed}).
+    A nonzero rc or an unparseable bench line is a failed-run record —
+    present in the verdict (a crash is evidence), never in a cohort
+    baseline."""
+    path = pathlib.Path(path)
+    try:
+        raw = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        return [_mk_record(path.name, failed=True, note=f"unreadable: {e}")]
+    if not isinstance(raw, dict):
+        return [_mk_record(path.name, failed=True, note="not an object")]
+    tail = raw.get("tail") or ""
+    fallback_hint = any(mark in tail for mark in _FALLBACK_TAIL_MARKS)
+    parsed = raw.get("parsed")
+    if raw.get("rc") not in (0, None) or not isinstance(parsed, dict):
+        return [_mk_record(
+            path.name, failed=True,
+            note=f"rc={raw.get('rc')}, no parsed bench record",
+        )]
+    rec = record_from_result(parsed, path.name, fallback_hint)
+    return [rec] if rec else []
+
+
+def load_good_artifact(path) -> list[dict]:
+    """A BENCH_TPU_GOOD*.json high-water-mark artifact: the ``last`` and
+    ``best`` stamped records (deduplicated when they are the same
+    measurement), or the legacy flat format as one record."""
+    path = pathlib.Path(path)
+    try:
+        raw = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return []
+    if not isinstance(raw, dict):
+        return []
+    if "last" in raw or "best" in raw:
+        out, seen = [], set()
+        for slot in ("last", "best"):
+            entry = raw.get(slot)
+            if not isinstance(entry, dict):
+                continue
+            stamp = (entry.get("measured_at_utc"), entry.get("value"))
+            if stamp in seen:
+                continue
+            seen.add(stamp)
+            rec = record_from_result(entry, f"{path.name}:{slot}")
+            if rec:
+                out.append(rec)
+        return out
+    rec = record_from_result(raw, path.name)
+    return [rec] if rec else []
+
+
+def load_session(path) -> list[dict]:
+    """Bench records out of a session.jsonl evidence log (the entries
+    whose ``result`` is a bench metric line; probe/sweep steps are not
+    comparable measurements and are skipped)."""
+    path = pathlib.Path(path)
+    try:
+        lines = path.read_text().splitlines()
+    except OSError:
+        return []
+    out = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entry = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(entry, dict):
+            continue
+        rec = record_from_result(
+            entry.get("result"),
+            f"{path.name}:{i + 1} ({entry.get('step', '?')})",
+        )
+        if rec:
+            out.append(rec)
+    return out
+
+
+def cohort_key(rec: dict):
+    """Records are only ever compared inside this key: same metric, same
+    grid, same dtype, same platform/backend/device-count."""
+    return (rec.get("metric"), tuple(rec.get("grid") or ()),
+            rec.get("dtype"), rec.get("platform"), rec.get("backend"),
+            rec.get("devices"))
+
+
+def _threshold(others: list[float], k: float, rel_tol: float) -> dict:
+    med = median(others)
+    mad = median(abs(v - med) for v in others)
+    guard = max(k * 1.4826 * mad, rel_tol * abs(med))
+    return {"median": med, "mad": mad, "threshold": med - guard}
+
+
+def evaluate(records: list[dict], k: float = 3.0,
+             rel_tol: float = 0.25) -> dict:
+    """Classify every record against its platform-matched cohort.
+
+    Classifications: ``failed_run`` (no measurement), ``platform_fallback``
+    (a downgraded run — compared only inside its own platform cohort,
+    never against the TPU baseline), ``no_baseline`` (first record of
+    its cohort), ``regression`` (below the cohort's noise-robust alarm
+    line), ``ok``. The overall verdict is ``regression`` iff any record
+    regressed — including a fallback record that slowed down relative
+    to OTHER fallback runs on the same platform (that comparison is
+    platform-matched, hence fair).
+    """
+    verdicts = []
+    for rec in records:
+        v = dict(rec)
+        if rec["failed"] or rec["value"] is None:
+            v["classification"] = "failed_run"
+            verdicts.append(v)
+            continue
+        others = [
+            r["value"] for r in records
+            if r is not rec and not r["failed"] and r["value"] is not None
+            and cohort_key(r) == cohort_key(rec)
+        ]
+        if not others:
+            v["classification"] = ("platform_fallback"
+                                   if rec["platform_fallback"]
+                                   else "no_baseline")
+            verdicts.append(v)
+            continue
+        stats = _threshold(others, k, rel_tol)
+        v.update(cohort_n=len(others),
+                 cohort_median=round(stats["median"], 2),
+                 cohort_mad=round(stats["mad"], 3),
+                 threshold=round(stats["threshold"], 2))
+        slowed = rec["value"] < stats["threshold"]
+        if rec["platform_fallback"]:
+            v["classification"] = ("platform_fallback_regression"
+                                   if slowed else "platform_fallback")
+        else:
+            v["classification"] = "regression" if slowed else "ok"
+        verdicts.append(v)
+    regressions = [v["source"] for v in verdicts
+                   if v["classification"].endswith("regression")]
+    counts: dict[str, int] = {}
+    for v in verdicts:
+        counts[v["classification"]] = counts.get(v["classification"], 0) + 1
+    return {
+        "schema": "poisson_tpu.regress/1",
+        "k": k,
+        "rel_tol": rel_tol,
+        "records": verdicts,
+        "classification_counts": counts,
+        "regressions": regressions,
+        "verdict": "regression" if regressions else "ok",
+    }
+
+
+def load_default_history(root=_ROOT) -> list[dict]:
+    """The repo's committed evidence set: driver snapshots
+    (BENCH_r*.json), high-water marks (BENCH_TPU_GOOD*.json), and the
+    TPU session log when present."""
+    root = pathlib.Path(root)
+    records: list[dict] = []
+    for path in sorted(root.glob("BENCH_r[0-9]*.json")):
+        records.extend(load_driver_artifact(path))
+    for path in sorted(root.glob("BENCH_TPU_GOOD*.json")):
+        records.extend(load_good_artifact(path))
+    session = root / "benchmarks" / "results" / "session.jsonl"
+    if session.exists():
+        records.extend(load_session(session))
+    return records
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=str(_ROOT),
+                    help="repo root to glob BENCH_*.json history from "
+                         "(default: this checkout)")
+    ap.add_argument("--history", nargs="*", default=None, metavar="FILE",
+                    help="explicit history files instead of the --root "
+                         "glob (driver snapshots, good artifacts, or raw "
+                         "bench JSON lines)")
+    ap.add_argument("--session", default=None, metavar="JSONL",
+                    help="additional session.jsonl evidence log")
+    ap.add_argument("--k", type=float, default=3.0,
+                    help="MAD multiplier for the alarm line (default 3)")
+    ap.add_argument("--rel-tol", type=float, default=0.25,
+                    help="relative floor under the median that is never "
+                         "an alarm (default 0.25 — run-to-run jitter)")
+    ap.add_argument("--pretty", action="store_true",
+                    help="indent the JSON verdict")
+    args = ap.parse_args(argv)
+
+    if args.history is not None:
+        records = []
+        for path in args.history:
+            name = pathlib.Path(path).name
+            if name.startswith("BENCH_TPU_GOOD"):
+                records.extend(load_good_artifact(path))
+            elif name.endswith(".jsonl"):
+                records.extend(load_session(path))
+            else:
+                records.extend(load_driver_artifact(path))
+    else:
+        records = load_default_history(args.root)
+    if args.session:
+        records.extend(load_session(args.session))
+    if not records:
+        print("regress: no bench records found", file=sys.stderr)
+        return 2
+    report = evaluate(records, k=args.k, rel_tol=args.rel_tol)
+    print(json.dumps(report, indent=1 if args.pretty else None))
+    return 1 if report["verdict"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

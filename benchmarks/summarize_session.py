@@ -19,10 +19,14 @@ treat it as a correctness incident, not a throughput number.
 from a unified-telemetry directory (``poisson_tpu.obs`` — what
 ``python -m poisson_tpu … --trace-dir DIR`` writes): phases and their
 durations, restarts/escalations, checkpoint activity, watchdog
-beats/stalls, stop verdicts, MLUPS, and the streamed convergence curve
-summary — the post-mortem the round-5 wedged tunnel never had. Reads
-the files directly (stdlib only): importing the framework would
-initialize jax, which a post-session forensics pass must never risk.
+beats/stalls, stop verdicts, MLUPS, the streamed convergence curve
+summary, the performance-attribution gauges (compiled-program cost vs
+the analytic stencil model, achieved-vs-roofline fraction —
+``poisson_tpu.obs.costs``), and the regression sentinel's verdict over
+the committed bench history (``benchmarks/regress.py``) — the
+post-mortem the round-5 wedged tunnel never had. Reads the files
+directly (stdlib only): importing the framework would initialize jax,
+which a post-session forensics pass must never risk.
 
 Usage: python benchmarks/summarize_session.py [session.jsonl] [--since ISO]
        python benchmarks/summarize_session.py --telemetry DIR
@@ -165,10 +169,10 @@ def _read_jsonl(path: pathlib.Path) -> list[dict]:
 
 
 def _load_telemetry(tdir: pathlib.Path):
-    """(events, counters, stream_by_rank) from an obs trace directory —
-    local readers on the documented schema; see the module docstring for
-    why this does not import poisson_tpu.obs."""
-    events, counters, stream = [], {}, {}
+    """(events, counters, gauges_by_rank, stream_by_rank) from an obs
+    trace directory — local readers on the documented schema; see the
+    module docstring for why this does not import poisson_tpu.obs."""
+    events, counters, gauges, stream = [], {}, {}, {}
     for p in sorted(tdir.glob("events-rank*.jsonl")):
         events.extend(_read_jsonl(p))
     events.sort(key=lambda r: r.get("at_unix", 0.0))
@@ -182,17 +186,79 @@ def _load_telemetry(tdir: pathlib.Path):
                 counters[name] = counters.get(name, 0) + val
             except TypeError:
                 continue
+        g = snap.get("gauges") or {}
+        if g:
+            gauges[str(snap.get("rank", p.stem))] = g
     for p in sorted(tdir.glob("stream-rank*.jsonl")):
         rank = p.stem.replace("stream-rank", "")
         stream[rank] = _read_jsonl(p)
-    return events, counters, stream
+    return events, counters, gauges, stream
+
+
+def _perf_attribution_section(gauges_by_rank: dict) -> None:
+    """Render the cost/roofline gauges (obs.costs) per rank: what the
+    compiled program cost vs the analytic model, and the bandwidth
+    fraction the run achieved."""
+    interesting = ("cost.", "roofline.")
+    rows = []
+    for rank, gauges in sorted(gauges_by_rank.items()):
+        for name in sorted(gauges):
+            if any(name.startswith(p) for p in interesting):
+                rows.append((rank, name, gauges[name]))
+    if not rows:
+        return
+    print("\n## Performance attribution\n")
+    print("| rank | gauge | value |")
+    print("|---|---|---|")
+    for rank, name, val in rows:
+        shown = f"{val:.4g}" if isinstance(val, float) else str(val)
+        print(f"| {rank} | {name} | {shown} |")
+    for rank, gauges in sorted(gauges_by_rank.items()):
+        agree = gauges.get("cost.model_agreement")
+        if isinstance(agree, (int, float)):
+            verdict = ("agrees with the analytic stencil model"
+                       if abs(agree - 1.0) <= 0.25
+                       else "DRIFTED from the analytic stencil model "
+                            "(solver work or compiler changed)")
+            print(f"\nrank {rank}: compiled bytes/iteration = "
+                  f"{agree:.2f}x the model — {verdict}.")
+        frac = gauges.get("roofline.fraction")
+        if isinstance(frac, (int, float)):
+            print(f"rank {rank}: achieved {frac:.0%} of the platform "
+                  f"bandwidth ceiling.")
+
+
+def _regress_verdict_section(root: pathlib.Path) -> None:
+    """The regression sentinel's verdict over the committed bench
+    history, rendered into the forensics report (best-effort: a missing
+    or failing sentinel must not sink the post-mortem)."""
+    try:
+        sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+        import regress
+
+        records = regress.load_default_history(root)
+        if not records:
+            return
+        report = regress.evaluate(records)
+        print("\n## Regression sentinel\n")
+        counts = ", ".join(f"{k}: {v}" for k, v in
+                           sorted(report["classification_counts"].items()))
+        print(f"- verdict: **{report['verdict']}** ({counts})")
+        for v in report["records"]:
+            if v["classification"].endswith("regression"):
+                print(f"- REGRESSION {v['source']}: {v['value']} vs "
+                      f"cohort median {v.get('cohort_median')} "
+                      f"(threshold {v.get('threshold')})")
+    except Exception as e:
+        print(f"\n(regression sentinel unavailable: {e!r})",
+              file=sys.stderr)
 
 
 def telemetry_report(tdir: pathlib.Path) -> int:
     if not tdir.is_dir():
         print(f"no telemetry directory at {tdir}", file=sys.stderr)
         return 1
-    events, counters, stream = _load_telemetry(tdir)
+    events, counters, gauges_by_rank, stream = _load_telemetry(tdir)
     traces = sorted(tdir.glob("trace-rank*.trace.json"))
     print(f"# Solve forensics: {tdir}")
     print(f"\n{len(events)} events, {len(traces)} rank trace(s)"
@@ -285,6 +351,9 @@ def telemetry_report(tdir: pathlib.Path) -> int:
             print(f"- rank {rank}: {len(samples)} samples, "
                   f"iter {first.get('k')} ||dw|| {first.get('diff'):.3e} "
                   f"→ iter {last.get('k')} ||dw|| {last.get('diff'):.3e}")
+
+    _perf_attribution_section(gauges_by_rank)
+    _regress_verdict_section(_ROOT)
     return 0
 
 

@@ -196,6 +196,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "every K iterations — live progress + recorded "
                         "curve (XLA backends; 0 = off, the default: the "
                         "compiled program is byte-identical)")
+    o.add_argument("--prom-out", metavar="PATH", default=None,
+                   help="write the counters/gauges as a Prometheus text-"
+                        "format snapshot to PATH at exit (the node-"
+                        "exporter textfile convention; README "
+                        "'Performance attribution')")
+    o.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve a live GET /metrics endpoint on "
+                        "127.0.0.1:PORT for the run's lifetime (0 = OS-"
+                        "assigned, reported on the export.http_port "
+                        "gauge) — the scrape contract for long multi-"
+                        "solve sessions")
     p.add_argument("--save-solution", metavar="PATH", default=None,
                    help="write the solution grid to PATH (.npy) — the "
                         "reference never persisted its solution")
@@ -542,8 +553,13 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
         result = result._replace(restarts=recovered[0],
                                  recovery_history=recovered[1])
 
-    if args.profile:
-        with jax.profiler.trace(args.profile):
+    # One extra untimed solve through the shared fenced capture path
+    # (obs.profile) when --profile names a dir OR POISSON_TPU_PROFILE_DIR
+    # configured one — the capture lands on the span timeline too.
+    from poisson_tpu.obs import profile as obs_profile
+
+    if args.profile or obs_profile.enabled():
+        with obs_profile.capture("cli.solve", profile_dir=args.profile):
             fence(run().iterations)
 
     from poisson_tpu.solvers.pcg import resolve_dtype
@@ -685,6 +701,12 @@ def _main_solve_batched(argv) -> int:
     gates = ([1.0 + i / B for i in range(B)] if args.vary_rhs
              else [1.0] * B)
 
+    # Env-driven profiler capture (the bench.py convention): the batched
+    # driver has the same contract without growing a flag per sink.
+    from poisson_tpu.obs import profile as obs_profile
+
+    obs_profile.configure_from_env()
+
     run = lambda: solve_batched(problem, rhs_gates=gates,
                                 dtype=args.dtype, bucket=args.bucket)
     timer = PhaseTimer()
@@ -730,6 +752,10 @@ def _main_solve_batched(argv) -> int:
         record["sequential_seconds"] = seq_seconds
         record["speedup_vs_sequential"] = seq_seconds / best
         record["iterations_match_sequential"] = seq_iters == iters
+
+    if obs_profile.enabled():
+        with obs_profile.capture("solve_batched"):
+            fence(run().iterations)
 
     obs.event("solve_batched.report", **record)
     obs.gauge("batched.solves_per_sec", record["solves_per_sec"])
@@ -793,12 +819,19 @@ def main(argv=None) -> int:
                          f"got {args.stream_every}")
     from poisson_tpu import obs
 
-    if args.trace_dir or args.metrics_out or args.stream_every:
+    if (args.trace_dir or args.metrics_out or args.stream_every
+            or args.prom_out or args.metrics_port is not None):
         obs.configure(
             trace_dir=args.trace_dir, metrics_path=args.metrics_out,
             stream_every=args.stream_every,
             stream_live=sys.stderr.isatty() and not args.json,
+            prom_path=args.prom_out, metrics_port=args.metrics_port,
         )
+    # Env-driven profiler capture dir, like bench.py (an explicit
+    # --profile DIR below still wins for its own capture).
+    from poisson_tpu.obs import profile as _obs_profile
+
+    _obs_profile.configure_from_env()
     if args.categories and args.json:
         raise SystemExit("--categories produces a table; drop --json")
     if args.checkpoint and args.backend == "native":

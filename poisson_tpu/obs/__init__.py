@@ -13,7 +13,19 @@ stack (PhaseTimer dicts, watchdog heartbeat JSON, restart history inside
   stop-flag, …) snapshotted to JSON at exit and merged per rank;
 - **streamed convergence** (:mod:`poisson_tpu.obs.stream`) — opt-in
   per-iteration residuals out of the fused ``lax.while_loop`` via
-  ``jax.debug.callback`` (off by default; golden counts stay bit-exact).
+  ``jax.debug.callback`` (off by default; golden counts stay bit-exact);
+- **performance attribution** (:mod:`poisson_tpu.obs.costs`) —
+  compiled-executable FLOPs/bytes vs the analytic 5-point-stencil cost
+  model, and achieved-vs-roofline fractions on bench records and solve
+  reports;
+- **profiler capture** (:mod:`poisson_tpu.obs.profile`) — fenced
+  programmatic ``jax.profiler.trace`` regions on the span rails,
+  env-driven like every other knob (``POISSON_TPU_PROFILE_DIR``);
+- **Prometheus exposition** (:mod:`poisson_tpu.obs.export`) — the
+  counter/gauge registry as scrape-able text: a textfile snapshot at
+  finalize (``POISSON_TPU_PROM_OUT``) and an opt-in live ``/metrics``
+  endpoint (``POISSON_TPU_METRICS_PORT``) for long multi-solve
+  sessions.
 
 Usage (the CLI wires this from ``--trace-dir``/``--metrics-out``/
 ``--stream-every``; ``bench.py`` from ``POISSON_TPU_TRACE_DIR`` etc.):
@@ -37,7 +49,7 @@ import atexit
 import contextlib
 from typing import Optional
 
-from poisson_tpu.obs import metrics, stream, trace
+from poisson_tpu.obs import metrics, profile, stream, trace
 from poisson_tpu.obs.metrics import gauge, inc
 from poisson_tpu.obs.trace import (
     TraceRecorder,
@@ -48,6 +60,8 @@ from poisson_tpu.obs.trace import (
 _RECORDER: Optional[TraceRecorder] = None
 _METRICS_PATH: Optional[str] = None
 _STREAM_EVERY: int = 0
+_PROM_PATH: Optional[str] = None
+_HTTP_SERVER = None
 _ATEXIT_REGISTERED = False
 
 
@@ -55,7 +69,10 @@ def configure(trace_dir: Optional[str] = None,
               metrics_path: Optional[str] = None,
               stream_every: int = 0,
               stream_live: bool = False,
-              rank: Optional[int] = None) -> TraceRecorder:
+              rank: Optional[int] = None,
+              profile_dir: Optional[str] = None,
+              prom_path: Optional[str] = None,
+              metrics_port: Optional[int] = None) -> TraceRecorder:
     """Install the process-wide telemetry configuration.
 
     ``trace_dir``: spans/events land in ``trace-rank{R}.trace.json`` +
@@ -64,14 +81,37 @@ def configure(trace_dir: Optional[str] = None,
     single-file counters snapshot. ``stream_every``: installs a
     :class:`~poisson_tpu.obs.stream.StreamSink`; the value must ALSO be
     passed to the solver (it is a static compile flag — ``configure``
-    only sets up the host side). Finalization runs at interpreter exit;
-    call :func:`finalize` earlier for deterministic artifact timing.
+    only sets up the host side). ``profile_dir``: enables
+    :func:`poisson_tpu.obs.profile.capture` regions. ``prom_path``:
+    Prometheus textfile snapshot written at finalize. ``metrics_port``:
+    serve a live ``GET /metrics`` endpoint on 127.0.0.1:port for the
+    lifetime of the configuration (0 = OS-assigned; the bound port lands
+    on the ``export.http_port`` gauge). Finalization runs at interpreter
+    exit; call :func:`finalize` earlier for deterministic artifact
+    timing.
     """
     global _RECORDER, _METRICS_PATH, _STREAM_EVERY, _ATEXIT_REGISTERED
+    global _PROM_PATH, _HTTP_SERVER
     shutdown()
     _RECORDER = TraceRecorder(trace_dir=trace_dir, rank=rank)
     _METRICS_PATH = metrics_path
     _STREAM_EVERY = max(0, int(stream_every))
+    _PROM_PATH = prom_path
+    profile.configure(profile_dir)
+    if metrics_port is not None:
+        from poisson_tpu.obs import export
+
+        try:
+            _HTTP_SERVER = export.start_http_server(metrics_port)
+        except Exception as e:
+            # Taken port, out-of-range port (OverflowError), anything —
+            # a broken metrics endpoint must not kill the solve; say so
+            # and move on.
+            import sys
+
+            print(f"obs: /metrics endpoint unavailable on port "
+                  f"{metrics_port}: {e}", file=sys.stderr)
+            _HTTP_SERVER = None
     if _STREAM_EVERY > 0:
         path = None
         if trace_dir:
@@ -88,21 +128,34 @@ def configure(trace_dir: Optional[str] = None,
 
 def configure_from_env() -> Optional[TraceRecorder]:
     """Configure from ``POISSON_TPU_TRACE_DIR`` / ``POISSON_TPU_METRICS_OUT``
-    / ``POISSON_TPU_STREAM_EVERY`` — the env-driven path for harnesses
-    (``bench.py``) whose argv is already spoken for. No-op (returns
-    None) when none of the variables are set."""
+    / ``POISSON_TPU_STREAM_EVERY`` / ``POISSON_TPU_PROFILE_DIR`` /
+    ``POISSON_TPU_PROM_OUT`` / ``POISSON_TPU_METRICS_PORT`` — the
+    env-driven path for harnesses (``bench.py``) whose argv is already
+    spoken for. No-op (returns None) when none of the variables are
+    set."""
     import os
 
     trace_dir = os.environ.get("POISSON_TPU_TRACE_DIR") or None
     metrics_path = os.environ.get("POISSON_TPU_METRICS_OUT") or None
+    profile_dir = os.environ.get("POISSON_TPU_PROFILE_DIR") or None
+    prom_path = os.environ.get("POISSON_TPU_PROM_OUT") or None
     try:
         stream_every = int(os.environ.get("POISSON_TPU_STREAM_EVERY", "0"))
     except ValueError:
         stream_every = 0
-    if not (trace_dir or metrics_path or stream_every > 0):
+    metrics_port: Optional[int] = None
+    try:
+        raw_port = os.environ.get("POISSON_TPU_METRICS_PORT")
+        if raw_port:
+            metrics_port = int(raw_port)
+    except ValueError:
+        metrics_port = None
+    if not (trace_dir or metrics_path or stream_every > 0 or profile_dir
+            or prom_path or metrics_port is not None):
         return None
     return configure(trace_dir=trace_dir, metrics_path=metrics_path,
-                     stream_every=stream_every)
+                     stream_every=stream_every, profile_dir=profile_dir,
+                     prom_path=prom_path, metrics_port=metrics_port)
 
 
 def recorder() -> Optional[TraceRecorder]:
@@ -158,17 +211,30 @@ def finalize() -> None:
     if _METRICS_PATH:
         metrics.write_snapshot(_METRICS_PATH,
                                rank=rec.rank if rec else None)
+    if _PROM_PATH:
+        from poisson_tpu.obs import export
+
+        export.write_textfile(_PROM_PATH)
 
 
 def shutdown() -> None:
     """Finalize and tear down the configuration (tests; back-to-back
     runs in one process)."""
-    global _RECORDER, _METRICS_PATH, _STREAM_EVERY
-    if _RECORDER is not None or _METRICS_PATH or stream.get_sink():
+    global _RECORDER, _METRICS_PATH, _STREAM_EVERY, _PROM_PATH
+    global _HTTP_SERVER
+    if (_RECORDER is not None or _METRICS_PATH or _PROM_PATH
+            or stream.get_sink()):
         finalize()
     rec, _RECORDER = _RECORDER, None
     if rec is not None:
         rec.close()
     stream.set_sink(None)
+    if _HTTP_SERVER is not None:
+        from poisson_tpu.obs import export
+
+        export.stop_http_server(_HTTP_SERVER)
+        _HTTP_SERVER = None
+    profile.configure(None)
     _METRICS_PATH = None
     _STREAM_EVERY = 0
+    _PROM_PATH = None

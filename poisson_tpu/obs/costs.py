@@ -1,0 +1,388 @@
+"""Performance attribution: what a solve *should* cost, and what it does.
+
+The third observability pillar, after spans (where time went) and
+counters (what happened): a closed-form cost model of the 5-point-stencil
+PCG iteration checked against XLA's own accounting of the compiled
+program, plus a roofline attribution of measured throughput — the
+Williams/Waterman/Patterson methodology (PAPERS.md) applied to real
+compiled executables instead of paper napkins.
+
+Three layers, deliberately kept distinct because they answer different
+questions:
+
+- **HLO operand traffic** (:func:`measured_iteration_cost`) — what
+  ``lowered.compile().cost_analysis()`` counts for ONE compiled PCG
+  iteration body: every fused kernel's operand+result bytes and FLOPs.
+  This is the compiler's truth about the program it built. Counts each
+  *use* (the five shifted stencil reads of ``p`` are five operands), so
+  it over-states DRAM traffic where tiles stay cache-resident — which is
+  exactly why it pairs with the analytic model rather than the roofline.
+- **the analytic stencil model** (:func:`analytic_iteration_cost`) — the
+  same quantity derived by hand from the iteration's dataflow as a
+  closed form in grid shape and dtype. Measured-vs-model agreement
+  within ±25% (pinned by ``tests/test_perf_obs.py``) is the invariant:
+  drift means either the solver's per-iteration work changed or the
+  compiler started building a different program — both worth an alarm
+  before any wall-clock regression shows up.
+- **roofline attribution** (:func:`roofline_summary`) — *effective* HBM
+  traffic per iteration (each backend's canvas-pass model, the numbers
+  ``benchmarks/roofline.py`` and BENCH.md's sanity rule already use)
+  times measured iterations over measured seconds, as a fraction of the
+  platform's bandwidth ceiling. This is the "how fast *should* this
+  be" number that bench records and SolveReports now carry.
+
+Everything here degrades to None-valued fields rather than raising:
+cost introspection is advisory, and a backend whose runtime does not
+implement ``cost_analysis`` (some PJRT plugins) must not take the solve
+or the bench down with it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from poisson_tpu.obs import metrics
+
+# -- the analytic model -------------------------------------------------
+#
+# Units: one "pass" = (M+1)·(N+1)·dtype_bytes — one full-grid array read
+# or written once. The tallies below count HLO operand+result traffic of
+# the fused loop body the way XLA's cost analysis does (each operand use
+# counts, including the five shifted stencil slices of p and the
+# while-loop keep/candidate selects that fusion cannot eliminate), so
+# the model and cost_analysis() measure the same quantity. The per-term
+# integers are exact dataflow counts; the trailing ``loop_overhead``
+# term absorbs what XLA's fusion keeps of the state-select/copy traffic
+# and is calibrated once against jax 0.4.37 HLO (the ±25% agreement
+# test in tests/test_perf_obs.py pins it against drift).
+#
+# Scaled body (the production fp32 path: Ã = D^-1/2 A D^-1/2, z ≡ r):
+_SCALED_BYTES_TERMS = {
+    # Ap = sc·A(sc·p): p as five shifted slices, a and b twice each,
+    # sc twice (pre- and post-multiply), one result write.
+    "stencil_apply": 5 + 2 + 2 + 2 + 1,
+    "denominator_dot": 2,           # (Ap, p)
+    # w' = w + αp, r' = r − αAp fused with the ‖Δw‖ and ζ reductions:
+    # reads p, w, r, Ap, sc; writes w', r'.
+    "state_update": 5 + 2,
+    "z_propagation": 2,             # z' = r' through the keep-select
+    "p_update": 3,                  # p' = r' + βp
+    "loop_overhead": 7,             # keep/candidate selects XLA retains
+}
+# Unscaled body (fp64 oracle parity: explicit Jacobi apply_Dinv with its
+# division and D==0 guards, which XLA fuses less aggressively):
+_UNSCALED_BYTES_TERMS = {
+    "stencil_apply": 5 + 2 + 2 + 1,     # no sc multiplies
+    "denominator_dot": 2,
+    "state_update": 4 + 2,              # reads p, w, r, Ap; writes w', r'
+    "preconditioner": 4,                # z' = D⁻¹r': reads r', d twice; writes z'
+    "zeta_dot": 2,                      # (z', r')
+    "p_update": 3,
+    "loop_overhead": 20,                # guarded division breaks fusion:
+    # z and the where-masks materialize instead of staying in-register
+}
+# FLOPs per grid point, same convention (XLA counts compares/selects):
+_SCALED_FLOPS_PER_POINT = 34.0
+_UNSCALED_FLOPS_PER_POINT = 54.0
+
+
+def grid_points(M: int, N: int) -> int:
+    """Full-grid points (M+1)·(N+1) — the array footprint every pass
+    model is quoted against."""
+    return (M + 1) * (N + 1)
+
+
+def analytic_iteration_cost(M: int, N: int, dtype_bytes: int = 4,
+                            scaled: bool = True) -> dict:
+    """Closed-form bytes and FLOPs of ONE PCG iteration on an (M, N)
+    grid — the 5-point-stencil model described in the module docstring.
+
+    Returns ``{"flops", "bytes", "passes", "flops_per_point", "terms"}``;
+    ``terms`` is the per-term pass tally so a drifted agreement check can
+    say *which* part of the model went stale.
+    """
+    terms = dict(_SCALED_BYTES_TERMS if scaled else _UNSCALED_BYTES_TERMS)
+    passes = float(sum(terms.values()))
+    fpp = _SCALED_FLOPS_PER_POINT if scaled else _UNSCALED_FLOPS_PER_POINT
+    pts = grid_points(M, N)
+    return {
+        "flops": fpp * pts,
+        "bytes": passes * pts * dtype_bytes,
+        "passes": passes,
+        "flops_per_point": fpp,
+        "terms": terms,
+    }
+
+
+# -- compiled-executable introspection ----------------------------------
+
+
+def program_costs(compiled) -> dict:
+    """``{"flops", "bytes_accessed"}`` from a compiled executable's
+    ``cost_analysis()`` (None values when the runtime does not implement
+    it — cost introspection is advisory, never fatal)."""
+    flops = bytes_accessed = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            flops = ca.get("flops")
+            bytes_accessed = ca.get("bytes accessed")
+    except Exception:
+        pass
+    return {"flops": flops, "bytes_accessed": bytes_accessed}
+
+
+def program_memory(compiled) -> dict:
+    """Peak-memory view of a compiled executable via
+    ``memory_analysis()``: argument/output/temp sizes plus their sum as
+    ``peak_bytes`` — the live-buffer upper bound the program needs."""
+    out = {"argument_bytes": None, "output_bytes": None,
+           "temp_bytes": None, "generated_code_bytes": None,
+           "peak_bytes": None}
+    try:
+        ma = compiled.memory_analysis()
+        out["argument_bytes"] = int(ma.argument_size_in_bytes)
+        out["output_bytes"] = int(ma.output_size_in_bytes)
+        out["temp_bytes"] = int(ma.temp_size_in_bytes)
+        out["generated_code_bytes"] = int(ma.generated_code_size_in_bytes)
+        out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                             + out["temp_bytes"])
+    except Exception:
+        pass
+    return out
+
+
+def measured_iteration_cost(problem, dtype=None, scaled=None) -> dict:
+    """Compile ONE PCG iteration body for ``problem`` and report what
+    XLA's cost analysis counted, next to the analytic model.
+
+    The body program is the attribution anchor: the solve's
+    ``while_loop`` body is counted once by HLO cost analysis regardless
+    of trip count, so compiling the body alone is the only way to read
+    per-iteration cost off a real executable. Sets the ``cost.hlo.*``
+    and ``cost.model.*`` gauges; returns the combined dict. Compilation
+    of the body is small (one fused elementwise/stencil program), but
+    not free — call this once per (problem, dtype) from harness code,
+    not per solve.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from poisson_tpu.solvers.pcg import (
+        iteration_program,
+        resolve_dtype,
+        resolve_scaled,
+    )
+
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    body, state = iteration_program(problem, dtype=dtype_name,
+                                    scaled=use_scaled)
+    compiled = jax.jit(body).lower(state).compile()
+    cost = program_costs(compiled)
+    mem = program_memory(compiled)
+    model = analytic_iteration_cost(
+        problem.M, problem.N, jnp.dtype(dtype_name).itemsize, use_scaled
+    )
+    agreement = None
+    if cost["bytes_accessed"]:
+        agreement = cost["bytes_accessed"] / model["bytes"]
+    report = {
+        "program": "xla_iteration_body",
+        "grid": [problem.M, problem.N],
+        "dtype": dtype_name,
+        "scaled": use_scaled,
+        "hlo_flops_per_iter": cost["flops"],
+        "hlo_bytes_per_iter": cost["bytes_accessed"],
+        "model_flops_per_iter": model["flops"],
+        "model_bytes_per_iter": model["bytes"],
+        "model_passes": model["passes"],
+        # hlo/model bytes ratio; 1.0 = perfect agreement, the ±25% band
+        # is the pinned invariant (tests/test_perf_obs.py).
+        "model_agreement": agreement,
+        "peak_memory_bytes": mem["peak_bytes"],
+    }
+    for key in ("hlo_flops_per_iter", "hlo_bytes_per_iter",
+                "model_flops_per_iter", "model_bytes_per_iter",
+                "model_agreement", "peak_memory_bytes"):
+        if report[key] is not None:
+            metrics.gauge(f"cost.{key}", report[key])
+    return report
+
+
+def solve_program_costs(problem, dtype=None, scaled=None,
+                        stream_every: int = 0) -> dict:
+    """Whole-solve-program introspection: FLOPs, bytes, and peak memory
+    of the actual jitted ``_solve`` executable (setup + fused loop +
+    epilogue; the loop body counted once — per-iteration attribution is
+    :func:`measured_iteration_cost`'s job). Costs a compile of the full
+    program, so it is harness-level (bench.py), not per-solve. Sets the
+    ``cost.solve.*`` gauges."""
+    import jax.numpy as jnp
+
+    from poisson_tpu.solvers.pcg import (
+        _solve,
+        host_setup,
+        resolve_dtype,
+        resolve_scaled,
+    )
+
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+    compiled = _solve.lower(problem, use_scaled, int(stream_every),
+                            a, b, rhs, aux).compile()
+    cost = program_costs(compiled)
+    mem = program_memory(compiled)
+    report = {
+        "program": "xla_solve",
+        "flops": cost["flops"],
+        "bytes_accessed": cost["bytes_accessed"],
+        "peak_memory_bytes": mem["peak_bytes"],
+        "argument_bytes": mem["argument_bytes"],
+        "temp_bytes": mem["temp_bytes"],
+    }
+    for key in ("flops", "bytes_accessed", "peak_memory_bytes"):
+        if report[key] is not None:
+            metrics.gauge(f"cost.solve.{key}", report[key])
+    return report
+
+
+# -- roofline attribution -----------------------------------------------
+
+# Effective HBM array passes per iteration by backend — how many times
+# the working set actually crosses the memory system once fusion and
+# cache residency are accounted for. These are the SAME constants
+# BENCH.md's physical-consistency rule and summarize_session's
+# passes-at-ceiling column use: the pallas numbers from the kernels'
+# strip pass models (benchmarks/roofline.py), the xla number from the
+# measured fusion break-even documented in BENCH.md. Distinct from the
+# HLO operand model above on purpose: operand counting double-counts
+# cache-resident reuse, so it must never be fed into a bandwidth
+# fraction.
+EFFECTIVE_PASSES = {
+    "xla": 8.0,
+    "sharded": 8.0,
+    "xla_batched": 8.0,
+    "pallas": 14.7,
+    "pallas_fused": 14.7,
+    "pallas-sharded": 14.7,
+    "pallas_sharded": 14.7,
+    "pallas-ca": 10.1,
+    "pallas_ca": 10.1,
+    "pallas-ca-sharded": 10.1,
+}
+
+# Published peak HBM bandwidth per chip, GB/s, matched by substring
+# against device_kind (libtpu strings: 'TPU v5 lite', 'TPU v5e',
+# 'TPU v4', ...). v5e aligned with the 0.82 TB/s measured stream ceiling
+# BENCH.md already standardizes on. POISSON_TPU_PEAK_GBPS overrides —
+# the knob for CPU hosts or unlisted parts.
+PEAK_GBPS_BY_DEVICE = (
+    ("v5 lite", 820.0),
+    ("v5litepod", 820.0),
+    ("v5e", 820.0),
+    ("v5p", 2765.0),
+    ("v6e", 1640.0),
+    ("v6 lite", 1640.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def platform_peak_gbps(device_kind: Optional[str]) -> Optional[float]:
+    """Bandwidth ceiling for a device_kind string (None when unknown).
+    ``POISSON_TPU_PEAK_GBPS`` wins when set — e.g. a CPU host whose
+    stream ceiling was measured once with ``benchmarks/roofline.py``."""
+    env = os.environ.get("POISSON_TPU_PEAK_GBPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    if not device_kind:
+        return None
+    kind = str(device_kind).lower()
+    for sub, gbps in PEAK_GBPS_BY_DEVICE:
+        if sub in kind:
+            return gbps
+    return None
+
+
+def roofline_summary(problem, backend: Optional[str], dtype_bytes: int,
+                     iterations: int, solve_seconds: float,
+                     device_kind: Optional[str] = None,
+                     devices: int = 1) -> dict:
+    """Achieved-vs-roofline attribution of one measured solve.
+
+    ``achieved_gbps`` = effective bytes/iteration (backend pass model ×
+    grid bytes) × iterations / seconds, per device; ``fraction`` divides
+    by the platform ceiling when one is known (None otherwise — an
+    honest "no ceiling on file" beats a made-up one). Sets the
+    ``roofline.*`` gauges.
+    """
+    passes = EFFECTIVE_PASSES.get(backend or "")
+    peak = platform_peak_gbps(device_kind)
+    achieved = None
+    if passes and solve_seconds and solve_seconds > 0 and iterations:
+        grid_bytes = grid_points(problem.M, problem.N) * dtype_bytes
+        achieved = (passes * grid_bytes * iterations
+                    / solve_seconds / max(1, devices) / 1e9)
+    fraction = (achieved / peak) if (achieved and peak) else None
+    report = {
+        "passes_model": passes,
+        "bytes_per_iter_model": (
+            passes * grid_points(problem.M, problem.N) * dtype_bytes
+            if passes else None
+        ),
+        "achieved_gbps": round(achieved, 2) if achieved else None,
+        "peak_gbps": peak,
+        "fraction": round(fraction, 4) if fraction else None,
+    }
+    for key in ("achieved_gbps", "peak_gbps", "fraction"):
+        if report[key] is not None:
+            metrics.gauge(f"roofline.{key}", report[key])
+    return report
+
+
+def bench_costs(problem, dtype=None, backend: Optional[str] = None,
+                iterations: Optional[int] = None,
+                solve_seconds: Optional[float] = None,
+                device_kind: Optional[str] = None, devices: int = 1,
+                full_program: bool = False) -> Optional[dict]:
+    """The cost block bench records embed: per-iteration HLO-vs-model
+    attribution plus the roofline fraction of the measured run.
+
+    The attribution anchor is always the XLA iteration body (the
+    reference program every backend is golden-checked against); pallas
+    executables are not introspectable through ``cost_analysis`` and the
+    block says so via ``program``. ``full_program=True`` additionally
+    compiles and introspects the whole jitted solve (``cost.solve.*``).
+    ``POISSON_TPU_COST_ANALYSIS=0`` disables the whole block; any
+    internal failure returns None rather than raising.
+    """
+    if os.environ.get("POISSON_TPU_COST_ANALYSIS", "1") == "0":
+        return None
+    try:
+        import jax.numpy as jnp
+
+        from poisson_tpu.solvers.pcg import resolve_dtype
+
+        dtype_name = resolve_dtype(dtype)
+        block = measured_iteration_cost(problem, dtype=dtype_name)
+        if full_program:
+            block["solve_program"] = solve_program_costs(
+                problem, dtype=dtype_name
+            )
+        if iterations and solve_seconds:
+            block["roofline"] = roofline_summary(
+                problem, backend, jnp.dtype(dtype_name).itemsize,
+                iterations, solve_seconds, device_kind, devices,
+            )
+        return block
+    except Exception:
+        return None

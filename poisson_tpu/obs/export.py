@@ -1,0 +1,165 @@
+"""Prometheus text-format exposition of the counter/gauge registry.
+
+The metrics registry (:mod:`poisson_tpu.obs.metrics`) snapshots to JSON
+for the forensics tooling; production serving stacks scrape. This module
+renders the same registry in Prometheus exposition format 0.0.4 — the
+scrape-and-alert contract an Orca-style serving deployment (PAPERS.md)
+assumes — two ways:
+
+- :func:`write_textfile` — one atomic snapshot file, the
+  node-exporter ``textfile`` collector convention for batch jobs
+  (bench runs, CI): write at exit, let the host's exporter pick it up.
+- :func:`start_http_server` — an opt-in stdlib ``http.server`` thread
+  serving ``GET /metrics`` live from the registry, for long-running
+  multi-solve sessions that a Prometheus can scrape directly. No
+  third-party client library — the exposition format is 40 lines of
+  text, and the container must not need pip.
+
+Naming: ``pcg.solves.converged`` → ``poisson_tpu_pcg_solves_converged``
+(dots and any other non-``[a-zA-Z0-9_]`` byte become underscores, one
+``poisson_tpu_`` namespace prefix). Counters render as ``# TYPE …
+counter``, numeric gauges as ``gauge``; non-numeric gauges (strings,
+lists — legal in the JSON snapshot) are skipped with a ``# skipped``
+comment because the exposition format has no place for them.
+:func:`parse_text` reads the format back — the round-trip contract
+``tests/test_perf_obs.py`` pins.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Optional
+
+from poisson_tpu.obs import metrics
+
+_PREFIX = "poisson_tpu_"
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def metric_name(name: str) -> str:
+    """Registry name → Prometheus metric name (sanitized + namespaced)."""
+    clean = _SANITIZE.sub("_", name)
+    if not clean or not (clean[0].isalpha() or clean[0] == "_"):
+        clean = "_" + clean
+    return _PREFIX + clean
+
+
+def _fmt_value(val) -> str:
+    # bool before int/float: True must render 1, not "True".
+    if isinstance(val, bool):
+        return "1" if val else "0"
+    return repr(float(val))
+
+
+def render(snapshot: Optional[dict] = None) -> str:
+    """The registry (or a given :func:`metrics.snapshot`) as exposition
+    text. Deterministic ordering (sorted names) so diffs are readable."""
+    snap = snapshot if snapshot is not None else metrics.snapshot()
+    lines: list[str] = []
+    for kind, bucket in (("counter", snap.get("counters") or {}),
+                         ("gauge", snap.get("gauges") or {})):
+        for name in sorted(bucket):
+            val = bucket[name]
+            if not isinstance(val, (int, float)):
+                lines.append(f"# skipped non-numeric {kind} {name!r}")
+                continue
+            prom = metric_name(name)
+            lines.append(f"# HELP {prom} poisson_tpu {kind} {name}")
+            lines.append(f"# TYPE {prom} {kind}")
+            lines.append(f"{prom} {_fmt_value(val)}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_text(text: str) -> dict:
+    """Exposition text → ``{metric_name: {"type": …, "value": float}}``
+    — the verification half of the round trip (not a general Prometheus
+    parser: no labels, which :func:`render` never emits)."""
+    out: dict[str, dict] = {}
+    types: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            parts = rest.split()
+            if len(parts) == 2:
+                types[parts[0]] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) != 2:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, raw = parts
+        out[name] = {"type": types.get(name), "value": float(raw)}
+    return out
+
+
+def write_textfile(path: str, snapshot: Optional[dict] = None) -> None:
+    """Atomically write :func:`render` to ``path`` (best-effort, like
+    every other telemetry sink: a full disk must not kill the solve)."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        with open(tmp, "w") as f:
+            f.write(render(snapshot))
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+
+
+# -- live /metrics endpoint ---------------------------------------------
+
+
+def start_http_server(port: int = 0, addr: str = "127.0.0.1"):
+    """Serve ``GET /metrics`` from the live registry on a daemon thread.
+
+    Returns the ``ThreadingHTTPServer`` (its ``server_port`` attribute
+    carries the bound port — pass 0 to let the OS pick, the test-friendly
+    mode). Stop with :func:`stop_http_server`. Binds loopback by default:
+    exposing metrics beyond the host is a deployment decision, not a
+    library default.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _MetricsHandler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes must not spam stderr
+            pass
+
+    server = ThreadingHTTPServer((addr, int(port)), _MetricsHandler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="poisson-tpu-metrics", daemon=True)
+    thread.start()
+    metrics.gauge("export.http_port", server.server_port)
+    return server
+
+
+def stop_http_server(server) -> None:
+    """Shut the endpoint down (idempotent, exception-safe)."""
+    if server is None:
+        return
+    try:
+        server.shutdown()
+        server.server_close()
+    except Exception:
+        pass

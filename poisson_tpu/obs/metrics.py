@@ -29,7 +29,24 @@ Naming convention (dotted, low cardinality):
   ``batched.bucket_cache.hits`` / ``batched.bucket_cache.misses`` —
   multi-RHS driver traffic (``solvers.batched``): members solved, padding
   overhead, and whether ragged batch sizes are reusing bucket
-  executables.
+  executables;
+- ``bench.backend_probe.failures`` — bench.py backend probes that
+  failed before a platform decision (a tunnel outage fingerprint, not a
+  slowdown — regress.py and the forensics report read it as such);
+- ``profile.captures`` / ``profile.errors`` — programmatic profiler
+  captures (``obs.profile``).
+
+Gauge families (``obs.costs`` sets these; ``obs.export`` exposes both
+counters and numeric gauges in Prometheus text format):
+
+- ``cost.hlo_{flops,bytes}_per_iter`` / ``cost.model_{flops,bytes}_per_iter``
+  / ``cost.model_agreement`` / ``cost.peak_memory_bytes`` — one compiled
+  PCG iteration body vs the analytic 5-point-stencil model;
+- ``cost.solve.{flops,bytes_accessed,peak_memory_bytes}`` — the whole
+  jitted solve program;
+- ``roofline.{achieved_gbps,peak_gbps,fraction}`` — measured throughput
+  against the platform bandwidth ceiling;
+- ``export.http_port`` — the live ``/metrics`` endpoint's bound port.
 """
 
 from __future__ import annotations

@@ -8,6 +8,15 @@ and agrees with itself (Chrome trace events have ``ph``/``ts``/``name``;
 the metrics snapshot counted the solve; the stream recorded samples; the
 golden 40×40 count of 50 iterations is unchanged by streaming).
 
+The performance-attribution half of the stack is exercised end to end
+too: a fenced profiler capture (``obs.profile``) of the solve, the
+compiled-iteration cost introspection against the analytic stencil
+model (``obs.costs``, agreement within ±25%), a Prometheus exposition
+round trip (``obs.export`` render → parse, live ``/metrics`` endpoint),
+and the regression sentinel (``benchmarks/regress.py``) on a synthetic
+history that must classify a platform fallback as such and flag a 2×
+slowdown.
+
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
 """
@@ -35,8 +44,11 @@ def run_selfcheck(out_dir: str) -> int:
     from poisson_tpu.utils.timing import solve_report
 
     metrics_path = os.path.join(out_dir, "metrics.json")
+    prom_path = os.path.join(out_dir, "metrics.prom")
+    profile_root = os.path.join(out_dir, "profile")
     rec = obs.configure(trace_dir=out_dir, metrics_path=metrics_path,
-                        stream_every=5)
+                        stream_every=5, prom_path=prom_path,
+                        profile_dir=profile_root)
     obs.inc("selfcheck.runs")
     with obs.span("selfcheck", grid="40x40"):
         problem = Problem(M=40, N=40)
@@ -49,6 +61,18 @@ def run_selfcheck(out_dir: str) -> int:
         solve_report(problem, streamed, time.perf_counter() - t0,
                      compile_seconds=0.0, dtype="selfcheck",
                      backend="selfcheck")
+        # Performance attribution: one compiled-iteration introspection
+        # against the analytic model (sets the cost.* gauges the
+        # exposition check below must carry through).
+        from poisson_tpu.obs import costs
+
+        attribution = costs.measured_iteration_cost(problem,
+                                                    dtype="float32")
+        # Fenced profiler capture of one extra solve (obs.profile).
+        from poisson_tpu.obs import profile
+
+        with profile.capture("selfcheck.solve"):
+            pcg_solve(problem).diff.block_until_ready()
     obs.event("selfcheck.done", iterations=int(streamed.iterations))
     obs.finalize()
 
@@ -114,9 +138,94 @@ def run_selfcheck(out_dir: str) -> int:
     if not samples or any(s["k"] % 5 != 0 for s in samples):
         return _fail(f"bad stream samples: {samples[:3]}")
 
+    # 6. Cost attribution: the compiled iteration body agreed with the
+    # analytic stencil model (the invariant the perf tests pin).
+    agree = attribution.get("model_agreement")
+    if agree is None:
+        return _fail("cost_analysis returned nothing for the iteration "
+                     "body on this backend")
+    if not (0.75 <= agree <= 1.25):
+        return _fail(f"compiled bytes/iter is {agree:.2f}x the analytic "
+                     "model (outside +-25%)")
+
+    # 7. Profiler capture: the fenced jax.profiler.trace produced an
+    # artifact tree.
+    capture_dir = os.path.join(profile_root, "selfcheck.solve")
+    n_profile_files = sum(
+        len(files) for _, _, files in os.walk(capture_dir)
+    )
+    if n_profile_files == 0:
+        return _fail(f"profiler capture produced no files in "
+                     f"{capture_dir}")
+
+    # 8. Prometheus exposition round trip: the finalize-written textfile
+    # parses and carries the counters and cost gauges through.
+    from poisson_tpu.obs import export
+
+    try:
+        parsed = export.parse_text(open(prom_path).read())
+    except (OSError, ValueError) as e:
+        return _fail(f"prometheus textfile {prom_path} unreadable: {e}")
+    solves = parsed.get("poisson_tpu_pcg_solves_converged")
+    if not solves or solves["type"] != "counter" or solves["value"] < 1:
+        return _fail(f"exposition lost the solve counter: {solves}")
+    if "poisson_tpu_cost_model_agreement" not in parsed:
+        return _fail("exposition lost the cost.model_agreement gauge")
+
+    # 9. Live /metrics endpoint serves the same text.
+    import urllib.request
+
+    server = export.start_http_server(port=0)
+    try:
+        url = f"http://127.0.0.1:{server.server_port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        served = export.parse_text(body)
+        if "poisson_tpu_pcg_solves_converged" not in served:
+            return _fail("/metrics endpoint missing the solve counter")
+    finally:
+        export.stop_http_server(server)
+
+    # 10. Regression sentinel end to end on a synthetic history: a
+    # platform fallback must classify as such (not page), a genuine 2x
+    # slowdown must page.
+    import sys as _sys
+
+    _repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if _repo_root not in _sys.path:
+        _sys.path.insert(0, _repo_root)
+    try:
+        from benchmarks import regress
+    except ImportError as e:
+        return _fail(f"benchmarks.regress not importable: {e}")
+
+    def _rec(value, platform, fallback=False):
+        return regress.record_from_result(
+            {"metric": "mlups", "value": value,
+             "detail": {"grid": [40, 40], "dtype": "float32",
+                        "backend": "xla", "devices": 1,
+                        "platform": platform,
+                        "platform_fallback": fallback}},
+            source=f"selfcheck:{platform}:{value}",
+        )
+    history = [_rec(24000.0, "tpu"), _rec(23800.0, "tpu"),
+               _rec(23900.0, "tpu"), _rec(160.0, "cpu", fallback=True)]
+    verdict = regress.evaluate(history)
+    if verdict["verdict"] != "ok":
+        return _fail(f"sentinel paged on a platform fallback: {verdict}")
+    fallback_cls = [v["classification"] for v in verdict["records"]
+                    if v["platform"] == "cpu"]
+    if fallback_cls != ["platform_fallback"]:
+        return _fail(f"fallback misclassified: {fallback_cls}")
+    slowed = regress.evaluate(history + [_rec(11900.0, "tpu")])
+    if slowed["verdict"] != "regression":
+        return _fail(f"sentinel missed a 2x slowdown: {slowed}")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
-          f"{len(counters)} counters ({out_dir})")
+          f"{len(counters)} counters, model agreement {agree:.2f}x, "
+          f"{n_profile_files} profile files, {len(parsed)} exposition "
+          f"metrics, sentinel ok ({out_dir})")
     return 0
 
 

@@ -45,7 +45,9 @@ def run_selfcheck() -> int:
     if len({int(k) for k in iters}) < 2:
         return fail("gates did not produce distinct iteration counts — "
                     "the masked freeze went unexercised")
-    if int(np.asarray(bat.flag).min()) != FLAG_CONVERGED:
+    if not (np.asarray(bat.flag) == FLAG_CONVERGED).all():
+        # Equality, not min(): the failure flags (breakdown/nonfinite/
+        # stagnated) rank ABOVE converged numerically.
         return fail("not every member converged")
     if int(bat.max_iterations) != max(int(r.iterations) for r in seq):
         return fail("max_iterations disagrees with the member vector")

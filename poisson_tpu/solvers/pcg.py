@@ -442,6 +442,33 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
     return _solve(problem, use_scaled, int(stream_every), a, b, rhs, aux)
 
 
+def iteration_program(problem: Problem, dtype=None, scaled=None):
+    """The one-iteration PCG body as a (jittable fn, example state) pair
+    — the per-iteration cost-attribution anchor (``obs.costs``).
+
+    XLA's HLO cost analysis counts a ``while_loop`` body once regardless
+    of trip count, so per-iteration FLOPs/bytes can only be read off a
+    compiled executable by compiling the body alone; this packages
+    exactly the body :func:`pcg_loop` runs (same ops bundle, same
+    coefficient closure, so the compiled program's operand traffic is
+    the solve's per-iteration truth). Precision/scaling policy matches
+    :func:`pcg_solve`.
+    """
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if use_scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    body = make_pcg_body(
+        ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+    return body, init_state(ops, rhs)
+
+
 def pcg_step_fn(problem: Problem, scaled: bool = True):
     """One fused PCG iteration for the flagship single-device problem —
     the jittable 'forward step' exposed to the harness (__graft_entry__).

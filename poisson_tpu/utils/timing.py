@@ -133,6 +133,14 @@ class SolveReport:
     # (``iterations`` above then holds the scalar max the fused loop ran).
     batch: Optional[int] = None
     iterations_per_member: Optional[list] = None
+    # Performance attribution (obs.costs): the backend's effective
+    # bytes/iteration model, the HBM bandwidth this run achieved, and
+    # the fraction of the platform ceiling that represents (None when
+    # the backend has no pass model or the ceiling is unknown — an
+    # honest gap, never a made-up number).
+    bytes_per_iter_model: Optional[float] = None
+    achieved_gbps: Optional[float] = None
+    roofline_fraction: Optional[float] = None
 
     def json_line(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -155,6 +163,16 @@ class SolveReport:
                 else ""
             ),
         ]
+        if self.achieved_gbps is not None:
+            rows.append(
+                f"  attribution: {self.achieved_gbps:.1f} GB/s effective"
+                + (
+                    f" = {self.roofline_fraction:.0%} of roofline"
+                    if self.roofline_fraction is not None
+                    else " (no bandwidth ceiling on file for this "
+                         "device; set POISSON_TPU_PEAK_GBPS)"
+                )
+            )
         if self.restarts:
             detail = "; ".join(
                 f"iter {k}: {verdict} -> {action}"
@@ -231,6 +249,24 @@ def solve_report(
     obs.inc("time.execute_seconds", max(0.0, solve_seconds))
     restarts = getattr(result, "restarts", None)
     recovery = getattr(result, "recovery_history", None)
+    # Roofline attribution (obs.costs): achieved bandwidth against the
+    # backend's pass model and the platform ceiling. Advisory — any
+    # failure (exotic dtype name, no pass model for this backend) leaves
+    # the fields None rather than touching the report's core job.
+    useful_iters = int(iters_arr.sum()) if batched else iters
+    bytes_per_iter = achieved_gbps = fraction = None
+    try:
+        from poisson_tpu.obs.costs import roofline_summary
+
+        rl = roofline_summary(
+            problem, backend, np.dtype(dtype).itemsize, useful_iters,
+            solve_seconds, device_kind=device_kind, devices=max(1, devices),
+        )
+        bytes_per_iter = rl["bytes_per_iter_model"]
+        achieved_gbps = rl["achieved_gbps"]
+        fraction = rl["fraction"]
+    except Exception:
+        pass
     return SolveReport(
         M=problem.M,
         N=problem.N,
@@ -238,12 +274,11 @@ def solve_report(
         solve_seconds=solve_seconds,
         compile_seconds=compile_seconds,
         # Batched: throughput counts every member's useful updates
-        # (Σ member iterations), not just the slowest member's — a B=64
-        # batch's MLUPS must be comparable with B=64 sequential reports,
-        # not ~64× under them.
-        mlups=mlups(problem,
-                    int(iters_arr.sum()) if batched else iters,
-                    solve_seconds),
+        # (Σ member iterations, same numerator the roofline attribution
+        # above uses), not just the slowest member's — a B=64 batch's
+        # MLUPS must be comparable with B=64 sequential reports, not
+        # ~64× under them.
+        mlups=mlups(problem, useful_iters, solve_seconds),
         final_diff=float(np.max(np.asarray(result.diff))),
         batch=(int(iters_arr.shape[0]) if batched else None),
         iterations_per_member=(
@@ -258,4 +293,7 @@ def solve_report(
         device_kind=device_kind,
         restarts=(int(restarts) if restarts else None),
         recovery=(tuple(recovery) if restarts and recovery else None),
+        bytes_per_iter_model=bytes_per_iter,
+        achieved_gbps=achieved_gbps,
+        roofline_fraction=fraction,
     )

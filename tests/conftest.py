@@ -57,6 +57,12 @@ def pytest_configure(config):
         "bit-parity, bucketing, CLI/bench throughput mode; CPU-fast; "
         "runs in tier-1, selectable with -m batched)",
     )
+    config.addinivalue_line(
+        "markers",
+        "perf_obs: performance attribution & regression sentinel suite "
+        "(cost model vs cost_analysis, Prometheus exposition, regress.py "
+        "verdicts; CPU-fast; runs in tier-1, selectable with -m perf_obs)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
