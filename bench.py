@@ -12,6 +12,13 @@ of the same problems on the same backend, and the detail records that the
 per-member iteration counts matched the sequential solver exactly (they
 must — the batched loop is the same body, masked).
 
+Service mode (``python bench.py --serve R [M N]``, default grid 400×600)
+measures the solve service (``poisson_tpu.serve``) under injected fault
+load — batch-killing poison requests exercising retry isolation:
+    {"metric": "serve.p99_latency", "value": S, "unit": "seconds", ...}
+with p50/p95, shed rate, and throughput in the detail, plus the
+``fault_load`` cohort discriminator the regression sentinel keys on.
+
 Both modes honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the persistent JAX
 compilation cache; hits/misses are counted in the metrics snapshot).
 
@@ -366,6 +373,101 @@ def _batched_bench(problem, batch: int, devices, platform: str,
     return 0
 
 
+def _serve_bench(problem, requests: int, devices, platform: str,
+                 downgraded: bool = False) -> int:
+    """Service mode: throughput and latency percentiles under fault load.
+
+    Drives the solve service (``poisson_tpu.serve``) with a request load
+    that includes batch-killing poison members (one per 16 requests), so
+    the reported percentiles price in the retry/isolation machinery —
+    the latency a *faulty* fleet delivers, which is the number an SLO
+    has to clear. The record's ``detail.fault_load`` names the mix and
+    is part of the regression sentinel's cohort key, so these runs are
+    never compared against clean baselines. One full warm-up pass keeps
+    compile time out of the percentiles (the executables are shared via
+    the jit cache).
+    """
+    import random
+
+    from poisson_tpu import obs
+    from poisson_tpu.serve import (
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import poison_batch_fault
+
+    n_poison = max(1, requests // 16)
+    fault_load = f"poison{n_poison}"
+    policy = ServicePolicy(
+        capacity=max(requests, 1), max_batch=32,
+        retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
+                          backoff_cap=0.1),
+    )
+
+    def build():
+        return SolveService(policy, seed=0,
+                            dispatch_fault=poison_batch_fault(
+                                set(range(n_poison))))
+
+    def load(svc):
+        rng = random.Random(0)
+        for i in range(requests):
+            svc.submit(SolveRequest(request_id=i, problem=problem,
+                                    rhs_gate=1.0 + rng.random(),
+                                    dtype="float32"))
+        svc.drain()
+        return svc
+
+    with obs.span("bench.serve_warmup", fence=False, requests=requests):
+        t0 = time.time()
+        load(build())                 # compile + first full campaign
+        first_run = time.time() - t0
+    obs.inc("time.compile_seconds", first_run)
+
+    with obs.span("bench.serve_timed", fence=False, requests=requests):
+        t0 = time.time()
+        svc = load(build())
+        wall = time.time() - t0
+    stats = svc.stats()
+    lat = stats["latency_seconds"]
+    record = {
+        "metric": "serve.p99_latency",
+        "value": round(lat["p99"], 4),
+        "unit": "seconds",
+        "detail": {
+            "grid": [problem.M, problem.N],
+            "requests": requests,
+            "completed": stats["completed"],
+            "errors": stats["errors"],
+            "shed": stats["shed"],
+            "lost": stats["lost"],
+            "shed_rate": round(stats["shed_rate"], 4),
+            "p50_seconds": round(lat["p50"], 4),
+            "p95_seconds": round(lat["p95"], 4),
+            "throughput_rps": round(stats["completed"] / wall, 2),
+            "wall_seconds": round(wall, 4),
+            "first_run_seconds": round(first_run, 2),
+            "dtype": "float32",
+            "backend": "xla_serve",
+            "devices": 1,
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "platform_fallback": downgraded,
+            # Cohort discriminator for benchmarks/regress.py: percentiles
+            # under this injected fault mix only ever compare against
+            # runs with the same mix.
+            "fault_load": fault_load,
+        },
+    }
+    obs.event("bench.serve", **record["detail"],
+              p99_latency=record["value"])
+    obs.finalize()
+    print(json.dumps(record))
+    return 0 if stats["lost"] == 0 else 1
+
+
 def main() -> int:
     downgraded, probe_failures = _acquire_backend()
     _adopt_layout_decision()
@@ -426,20 +528,40 @@ def main() -> int:
         try:
             batch = int(argv[i + 1])
         except (IndexError, ValueError):
-            print("usage: python bench.py [--batch B] [M N]",
+            print("usage: python bench.py [--batch B | --serve R] [M N]",
                   file=sys.stderr)
             return 2
         argv = argv[:i] + argv[i + 2:]
         if batch < 1:
             print(f"--batch must be >= 1, got {batch}", file=sys.stderr)
             return 2
+    serve_requests = None
+    if "--serve" in argv:
+        i = argv.index("--serve")
+        try:
+            serve_requests = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python bench.py [--batch B | --serve R] [M N]",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if serve_requests < 1:
+            print(f"--serve must be >= 1, got {serve_requests}",
+                  file=sys.stderr)
+            return 2
+    if batch is not None and serve_requests is not None:
+        print("--batch and --serve are separate bench modes; pick one",
+              file=sys.stderr)
+        return 2
     if len(argv) == 2:
         problem = Problem(M=int(argv[0]), N=int(argv[1]))
     elif len(argv) == 0:
-        problem = (Problem(M=400, N=600) if batch is not None
+        problem = (Problem(M=400, N=600)
+                   if batch is not None or serve_requests is not None
                    else Problem(M=800, N=1200))
     else:
-        print("usage: python bench.py [--batch B] [M N]", file=sys.stderr)
+        print("usage: python bench.py [--batch B | --serve R] [M N]",
+              file=sys.stderr)
         return 2
     dtype = jnp.float32
     # SIGALRM watchdog: the probe can pass and the tunnel wedge a moment
@@ -475,6 +597,9 @@ def main() -> int:
     if batch is not None:
         return _batched_bench(problem, batch, devices, platform,
                               downgraded=downgraded)
+    if serve_requests is not None:
+        return _serve_bench(problem, serve_requests, devices, platform,
+                            downgraded=downgraded)
 
     def xla_run(gate=None):
         if len(devices) > 1:

@@ -27,6 +27,13 @@ behind one. So:
    bare in CI (``python benchmarks/regress.py``) and rendered by
    ``summarize_session.py --telemetry``'s forensics report.
 
+Service-mode records (``bench.py --serve``: ``serve.p99_latency``,
+``serve.shed_rate``) get two extra rules: they regress *upward* (a p99
+that grew is the slowdown), and their injected fault mix
+(``detail.fault_load``) is part of the cohort key — a latency percentile
+measured under chaos faults is a different experiment from a clean run
+and is never judged against its baseline.
+
 Stdlib only, no jax import: like the forensics renderer, a post-session
 gate must never risk initializing a backend.
 
@@ -53,12 +60,19 @@ _FALLBACK_TAIL_MARKS = (
     "tunnel was unreachable",
 )
 
-_METRICS = ("mlups", "batched_solves_per_sec")
+_METRICS = ("mlups", "batched_solves_per_sec",
+            "serve.p99_latency", "serve.shed_rate")
+
+# Service metrics regress UPWARD: a p99 latency or a shed rate that grew
+# is the slowdown, where MLUPS/solves-per-sec regress downward. The
+# alarm line flips sides accordingly (median + guard instead of − guard).
+_LOWER_IS_BETTER = {"serve.p99_latency", "serve.shed_rate"}
 
 
 def _mk_record(source: str, *, value=None, metric=None, platform=None,
                backend=None, grid=None, dtype=None, devices=None,
                platform_fallback=False, failed=False,
+               fault_load: Optional[str] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -70,6 +84,12 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         "dtype": dtype,
         "devices": devices,
         "platform_fallback": bool(platform_fallback),
+        # Service-mode records measured under injected fault load (the
+        # chaos/bench fault campaigns) carry the fault mix here; it is
+        # part of the cohort key, so a fault-load p99 is never judged
+        # against a clean baseline (a latency percentile under injected
+        # slow-workers is a different experiment, not a regression).
+        "fault_load": fault_load,
         "failed": bool(failed),
         "note": note,
     }
@@ -94,6 +114,7 @@ def record_from_result(result: dict, source: str,
         dtype=det.get("dtype"),
         devices=det.get("devices"),
         platform_fallback=fallback,
+        fault_load=det.get("fault_load"),
     )
 
 
@@ -181,17 +202,23 @@ def load_session(path) -> list[dict]:
 
 def cohort_key(rec: dict):
     """Records are only ever compared inside this key: same metric, same
-    grid, same dtype, same platform/backend/device-count."""
+    grid, same dtype, same platform/backend/device-count — and, for
+    service-mode records, the same injected fault load (fault-load runs
+    are never judged against clean baselines)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
-            rec.get("devices"))
+            rec.get("devices"), rec.get("fault_load"))
 
 
-def _threshold(others: list[float], k: float, rel_tol: float) -> dict:
+def _threshold(others: list[float], k: float, rel_tol: float,
+               lower_is_better: bool = False) -> dict:
+    """The cohort's alarm line: guard below the median for
+    higher-is-better metrics, above it for lower-is-better ones."""
     med = median(others)
     mad = median(abs(v - med) for v in others)
     guard = max(k * 1.4826 * mad, rel_tol * abs(med))
-    return {"median": med, "mad": mad, "threshold": med - guard}
+    return {"median": med, "mad": mad,
+            "threshold": med + guard if lower_is_better else med - guard}
 
 
 def evaluate(records: list[dict], k: float = 3.0,
@@ -225,12 +252,15 @@ def evaluate(records: list[dict], k: float = 3.0,
                                    else "no_baseline")
             verdicts.append(v)
             continue
-        stats = _threshold(others, k, rel_tol)
+        lower_better = rec.get("metric") in _LOWER_IS_BETTER
+        stats = _threshold(others, k, rel_tol,
+                           lower_is_better=lower_better)
         v.update(cohort_n=len(others),
                  cohort_median=round(stats["median"], 2),
                  cohort_mad=round(stats["mad"], 3),
                  threshold=round(stats["threshold"], 2))
-        slowed = rec["value"] < stats["threshold"]
+        slowed = (rec["value"] > stats["threshold"] if lower_better
+                  else rec["value"] < stats["threshold"])
         if rec["platform_fallback"]:
             v["classification"] = ("platform_fallback_regression"
                                    if slowed else "platform_fallback")

@@ -21,6 +21,13 @@ the fictitious-domain method — see SURVEY.md):
                  and opt-in streamed convergence out of the fused loop —
                  the production observability layer the reference's five
                  hand-placed ``MPI_Wtime`` accumulators only hinted at.
+- ``serve``    — the request-lifecycle layer over the solvers: bounded
+                 admission with typed shedding, per-request deadlines
+                 propagated into chunked solves, retry/backoff with
+                 poisoned-member bucket isolation, per-cohort circuit
+                 breaking, and a graceful-degradation ladder — chaos-
+                 tested (``testing.chaos``; ``python -m poisson_tpu
+                 chaos --all``) against the no-lost-request invariant.
 
 The single-device solver is the stage0/stage1 equivalent; the sharded solver is
 the stage2/3/4 equivalent; Pallas kernels play the role of stage4's CUDA kernels.

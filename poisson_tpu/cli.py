@@ -17,6 +17,14 @@ Poisson problems per dispatch):
     python -m poisson_tpu solve-batched M N --batch B [--vary-rhs]
                               [--compare-sequential] [--dtype ...] [--json]
 
+plus the solve-service fire drill and its chaos campaign
+(``poisson_tpu.serve`` / ``testing.chaos`` — README "Solve service &
+chaos testing"):
+
+    python -m poisson_tpu serve M N --requests R [--deadline S]
+                              [--fault-poison K] [--prom-out PATH] [--json]
+    python -m poisson_tpu chaos --all --seed 0 [--out-dir DIR] [--json]
+
 Both entry points honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the JAX
 persistent compilation cache, ``utils.compile_cache``): traced programs
 persist across processes, and cache hits/misses land in the metrics
@@ -780,11 +788,239 @@ def _main_solve_batched(argv) -> int:
     return 0
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m poisson_tpu serve",
+        description="Solve-service fire drill (poisson_tpu.serve): admit "
+                    "a request load, run the lifecycle loop — bounded "
+                    "admission, deadlines, retry/backoff, circuit "
+                    "breaking, graceful degradation — and report the "
+                    "typed-outcome taxonomy with latency percentiles.",
+    )
+    p.add_argument("M", type=int, help="grid cells in x (nodes: M+1)")
+    p.add_argument("N", type=int, help="grid cells in y (nodes: N+1)")
+    p.add_argument("--requests", type=int, default=32, metavar="R",
+                   help="requests to submit (default 32)")
+    p.add_argument("--capacity", type=int, default=64,
+                   help="admission queue bound (default 64; submit more "
+                        "than this to watch typed overload shedding)")
+    p.add_argument("--max-batch", type=int, default=32,
+                   help="members per fused batched dispatch (default 32)")
+    p.add_argument("--deadline", type=float, default=None, metavar="S",
+                   help="per-request deadline in seconds (chunked "
+                        "dispatch; expiry returns a partial result)")
+    p.add_argument("--chunk", type=int, default=None,
+                   help="iterations between deadline checks on chunked "
+                        "dispatches (default 50)")
+    p.add_argument("--delta", type=float, default=1e-6,
+                   help="convergence threshold (default 1e-6)")
+    p.add_argument("--max-iter", type=int, default=None,
+                   help="iteration cap (default (M-1)(N-1))")
+    p.add_argument("--dtype", choices=("float32", "float64"), default=None,
+                   help="state precision (default: float64 if x64 on, "
+                        "else float32)")
+    p.add_argument("--vary-rhs", action="store_true",
+                   help="give each request a distinct RHS magnitude")
+    p.add_argument("--seed", type=int, default=0,
+                   help="backoff-jitter / load RNG seed (default 0)")
+    p.add_argument("--fault-poison", type=int, default=0, metavar="K",
+                   help="fault injection: mark the first K requests as "
+                        "batch-killing poison (typed transient errors "
+                        "after retry isolation)")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="write the counters/gauges snapshot here at exit")
+    p.add_argument("--prom-out", metavar="PATH", default=None,
+                   help="write a Prometheus textfile snapshot here at "
+                        "exit (serve.* counters included)")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line instead of a table")
+    return p
+
+
+def _main_serve(argv) -> int:
+    args = build_serve_parser().parse_args(argv)
+    if args.requests < 1:
+        raise SystemExit(f"--requests must be >= 1, got {args.requests}")
+    if args.capacity < 1:
+        raise SystemExit(f"--capacity must be >= 1, got {args.capacity}")
+    honor_jax_platforms_env()
+    from poisson_tpu import obs
+    from poisson_tpu.utils.compile_cache import enable_from_env
+
+    enable_from_env()
+    if args.metrics_out or args.prom_out:
+        obs.configure(metrics_path=args.metrics_out,
+                      prom_path=args.prom_out)
+    if args.dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+    import random as _random
+
+    from poisson_tpu.serve import (
+        OUTCOME_ERROR,
+        OUTCOME_RESULT,
+        OUTCOME_SHED,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+
+    problem = Problem(M=args.M, N=args.N, delta=args.delta,
+                      max_iter=args.max_iter)
+    fault = None
+    if args.fault_poison:
+        from poisson_tpu.testing.faults import poison_batch_fault
+
+        fault = poison_batch_fault(set(range(args.fault_poison)))
+    svc = SolveService(
+        ServicePolicy(capacity=args.capacity, max_batch=args.max_batch,
+                      default_chunk=args.chunk or 50),
+        seed=args.seed, dispatch_fault=fault,
+    )
+    rng = _random.Random(args.seed)
+    t0 = time.perf_counter()
+    for i in range(args.requests):
+        svc.submit(SolveRequest(
+            request_id=i, problem=problem,
+            rhs_gate=(1.0 + rng.random() if args.vary_rhs else 1.0),
+            dtype=args.dtype, deadline_seconds=args.deadline,
+            chunk=args.chunk,
+        ))
+    svc.drain()
+    wall = time.perf_counter() - t0
+    outs = svc.outcomes()
+    stats = svc.stats()
+    converged = sum(1 for o in outs
+                    if o.kind == OUTCOME_RESULT and o.converged)
+    partial = sum(1 for o in outs
+                  if o.kind == OUTCOME_RESULT and o.partial)
+    record = {
+        "M": problem.M, "N": problem.N, "requests": args.requests,
+        "wall_seconds": round(wall, 4),
+        "throughput_rps": round(stats["completed"] / wall, 2) if wall
+        else None,
+        "completed": stats["completed"], "converged": converged,
+        "partial": partial, "errors": stats["errors"],
+        "shed": stats["shed"], "lost": stats["lost"],
+        "shed_rate": round(stats["shed_rate"], 4),
+        "latency_seconds": {k: round(v, 4) for k, v in
+                            stats["latency_seconds"].items()},
+        "breakers": stats["breakers"],
+    }
+    obs.event("serve.report", **record)
+    obs.finalize()
+    if args.json:
+        print(json.dumps(record))
+        return 0 if stats["lost"] == 0 else 1
+    lat = record["latency_seconds"]
+    print(f"serve: M={problem.M}, N={problem.N} | {args.requests} requests "
+          f"in {wall:.2f} s ({record['throughput_rps']} completed/s)")
+    print(f"  outcomes: {stats['completed']} results ({converged} "
+          f"converged, {partial} partial) | {stats['errors']} typed "
+          f"errors | {stats['shed']} shed | lost {stats['lost']}")
+    print(f"  latency p50/p95/p99: {lat['p50']}/{lat['p95']}/{lat['p99']} "
+          f"s | shed rate {record['shed_rate']:.1%}")
+    kinds = {}
+    for o in outs:
+        key = (o.kind if o.kind != OUTCOME_ERROR
+               else f"error:{o.error_type}")
+        if o.kind == OUTCOME_SHED:
+            key = f"shed:{o.shed_reason}"
+        kinds[key] = kinds.get(key, 0) + 1
+    print("  taxonomy: " + ", ".join(f"{k}={v}"
+                                     for k, v in sorted(kinds.items())))
+    return 0 if stats["lost"] == 0 else 1
+
+
+def build_chaos_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m poisson_tpu chaos",
+        description="Chaos campaign (poisson_tpu.testing.chaos): named, "
+                    "seeded, deterministic fault scenarios over the "
+                    "solve service and the chunked solvers, asserting "
+                    "the no-lost-request invariant from the emitted "
+                    "serve.* metrics snapshot. Exit 0 iff every "
+                    "scenario's checks hold.",
+    )
+    p.add_argument("scenarios", nargs="*", metavar="SCENARIO",
+                   help="scenario names to run (see --list)")
+    p.add_argument("--all", action="store_true",
+                   help="run every registered scenario")
+    p.add_argument("--seed", type=int, default=0,
+                   help="campaign seed (default 0; same seed → same "
+                        "outcomes)")
+    p.add_argument("--list", action="store_true",
+                   help="list scenario names and exit")
+    p.add_argument("--out-dir", metavar="DIR", default=None,
+                   help="keep per-scenario metrics snapshots (JSON + "
+                        "Prometheus text) and the campaign report here")
+    p.add_argument("--json", action="store_true",
+                   help="print the campaign report as JSON")
+    return p
+
+
+def _main_chaos(argv) -> int:
+    args = build_chaos_parser().parse_args(argv)
+    honor_jax_platforms_env()
+    from poisson_tpu.testing import chaos
+
+    if args.list:
+        for name in chaos.scenario_names():
+            print(name)
+        return 0
+    if args.all and args.scenarios:
+        raise SystemExit("give scenario names or --all, not both")
+    if not args.all and not args.scenarios:
+        raise SystemExit("nothing to run: give scenario names or --all "
+                         "(--list shows the catalogue)")
+    unknown = [n for n in args.scenarios
+               if n not in chaos.scenario_names()]
+    if unknown:
+        raise SystemExit(
+            f"unknown scenario(s) {', '.join(unknown)}; known: "
+            f"{', '.join(chaos.scenario_names())}"
+        )
+    import jax
+
+    # The degradation ladder's precision downshift is only observable
+    # when the default precision is float64 — pin the campaign's
+    # numerical environment so a scenario behaves identically under
+    # pytest (x64 on) and from a bare CLI.
+    jax.config.update("jax_enable_x64", True)
+    campaign = chaos.run_campaign(
+        args.scenarios or None, seed=args.seed, out_dir=args.out_dir)
+    if args.json:
+        print(json.dumps(campaign))
+        return 0 if campaign["ok"] else 1
+    for rep in campaign["scenarios"]:
+        mark = "ok " if rep["ok"] else "FAIL"
+        inv = rep["invariant"]
+        line = (f"{mark} {rep['scenario']:28s} admitted={inv['admitted']:3d}"
+                f" lost={inv['lost']}")
+        failed = [k for k, v in rep["checks"].items() if not v]
+        if failed:
+            line += "  failed: " + ", ".join(failed)
+        print(line)
+    verdict = "ok" if campaign["ok"] else "FAILED"
+    print(f"chaos campaign {verdict}: {len(campaign['scenarios'])} "
+          f"scenario(s), seed {campaign['seed']}")
+    if args.out_dir:
+        print(f"per-scenario metrics snapshots in {args.out_dir}",
+              file=sys.stderr)
+    return 0 if campaign["ok"] else 1
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "solve-batched":
         return _main_solve_batched(argv[1:])
+    if argv and argv[0] == "serve":
+        return _main_serve(argv[1:])
+    if argv and argv[0] == "chaos":
+        return _main_chaos(argv[1:])
     args = build_parser().parse_args(argv)
     # Reconcile the positional and flag grid forms: exactly one per axis.
     for axis in ("M", "N"):

@@ -18,10 +18,14 @@ assumes — two ways:
 Naming: ``pcg.solves.converged`` → ``poisson_tpu_pcg_solves_converged``
 (dots and any other non-``[a-zA-Z0-9_]`` byte become underscores, one
 ``poisson_tpu_`` namespace prefix). Counters render as ``# TYPE …
-counter``, numeric gauges as ``gauge``; non-numeric gauges (strings,
-lists — legal in the JSON snapshot) are skipped with a ``# skipped``
-comment because the exposition format has no place for them.
-:func:`parse_text` reads the format back — the round-trip contract
+counter``, numeric gauges as ``gauge``; a gauge whose value is a dict of
+numeric quantiles (the solve service's ``serve.latency_seconds`` =
+``{"p50": …, "p95": …, "p99": …}``) renders as a Prometheus *summary*
+with ``quantile`` labels — the native exposition of latency percentiles,
+so a scrape alerts on ``…{quantile="0.99"}`` directly. Other non-numeric
+gauges (strings, lists — legal in the JSON snapshot) are skipped with a
+``# skipped`` comment because the exposition format has no place for
+them. :func:`parse_text` reads the format back — the round-trip contract
 ``tests/test_perf_obs.py`` pins.
 """
 
@@ -53,6 +57,19 @@ def _fmt_value(val) -> str:
     return repr(float(val))
 
 
+_QUANTILE = re.compile(r"^p(\d{1,2}(?:\.\d+)?)$")
+
+
+def _quantile_label(key: str) -> Optional[str]:
+    """``p50``/``p95``/``p99``/``p99.9`` → the Prometheus quantile value
+    ``0.5``/``0.95``/``0.99``/``0.999``; None for non-percentile keys."""
+    m = _QUANTILE.match(key)
+    if not m:
+        return None
+    q = float(m.group(1)) / 100.0
+    return f"{q:g}"
+
+
 def render(snapshot: Optional[dict] = None) -> str:
     """The registry (or a given :func:`metrics.snapshot`) as exposition
     text. Deterministic ordering (sorted names) so diffs are readable."""
@@ -62,10 +79,27 @@ def render(snapshot: Optional[dict] = None) -> str:
                          ("gauge", snap.get("gauges") or {})):
         for name in sorted(bucket):
             val = bucket[name]
+            prom = metric_name(name)
+            if (kind == "gauge" and isinstance(val, dict) and val
+                    and all(isinstance(v, (int, float))
+                            and not isinstance(v, bool)
+                            for v in val.values())
+                    and all(_quantile_label(k) for k in val)):
+                # Percentile family (e.g. serve.latency_seconds): render
+                # as a summary with quantile labels, the native
+                # Prometheus shape for a latency distribution.
+                lines.append(f"# HELP {prom} poisson_tpu summary {name}")
+                lines.append(f"# TYPE {prom} summary")
+                for key in sorted(val, key=lambda k:
+                                  float(_quantile_label(k))):
+                    lines.append(
+                        f'{prom}{{quantile="{_quantile_label(key)}"}} '
+                        f"{_fmt_value(val[key])}"
+                    )
+                continue
             if not isinstance(val, (int, float)):
                 lines.append(f"# skipped non-numeric {kind} {name!r}")
                 continue
-            prom = metric_name(name)
             lines.append(f"# HELP {prom} poisson_tpu {kind} {name}")
             lines.append(f"# TYPE {prom} {kind}")
             lines.append(f"{prom} {_fmt_value(val)}")
@@ -75,7 +109,10 @@ def render(snapshot: Optional[dict] = None) -> str:
 def parse_text(text: str) -> dict:
     """Exposition text → ``{metric_name: {"type": …, "value": float}}``
     — the verification half of the round trip (not a general Prometheus
-    parser: no labels, which :func:`render` never emits)."""
+    parser: the only label form it understands is the single
+    ``{quantile="…"}`` that :func:`render` emits for summary families;
+    such samples are keyed by their full labeled name, with the type
+    resolved from the family's TYPE line)."""
     out: dict[str, dict] = {}
     types: dict[str, str] = {}
     for line in text.splitlines():
@@ -90,11 +127,12 @@ def parse_text(text: str) -> dict:
             continue
         if line.startswith("#"):
             continue
-        parts = line.split()
+        parts = line.split(None, 1)
         if len(parts) != 2:
             raise ValueError(f"unparseable exposition line: {line!r}")
         name, raw = parts
-        out[name] = {"type": types.get(name), "value": float(raw)}
+        base = name.partition("{")[0]
+        out[name] = {"type": types.get(base), "value": float(raw)}
     return out
 
 

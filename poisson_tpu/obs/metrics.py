@@ -34,7 +34,22 @@ Naming convention (dotted, low cardinality):
   failed before a platform decision (a tunnel outage fingerprint, not a
   slowdown — regress.py and the forensics report read it as such);
 - ``profile.captures`` / ``profile.errors`` — programmatic profiler
-  captures (``obs.profile``).
+  captures (``obs.profile``);
+- ``serve.*`` — the solve service's request ledger
+  (``poisson_tpu.serve``), the counters the chaos campaign's
+  no-lost-request invariant is asserted from
+  (``admitted == completed + errors + shed`` once drained):
+  ``serve.admitted`` / ``serve.completed`` (+ ``.partial``,
+  ``.recovered``) / ``serve.errors.{divergence,transient,internal}`` /
+  ``serve.shed.{queue_full,breaker_open,deadline_expired}``;
+  lifecycle machinery: ``serve.dispatches`` / ``serve.batch_members`` /
+  ``serve.retries`` / ``serve.backoff_seconds`` /
+  ``serve.requeued.isolated`` / ``serve.escalations`` /
+  ``serve.deadline.{expired_in_queue,expired_mid_solve}`` /
+  ``serve.breaker.{trips,half_opens,closes}`` / the degradation ladder
+  ``serve.degraded.{padding,iteration_cap,precision}``; plus the
+  deadline stops the chunked drivers count
+  (``checkpoint.deadline_stops`` / ``resilient.deadline_stops``).
 
 Gauge families (``obs.costs`` sets these; ``obs.export`` exposes both
 counters and numeric gauges in Prometheus text format):
@@ -46,7 +61,12 @@ counters and numeric gauges in Prometheus text format):
   jitted solve program;
 - ``roofline.{achieved_gbps,peak_gbps,fraction}`` — measured throughput
   against the platform bandwidth ceiling;
-- ``export.http_port`` — the live ``/metrics`` endpoint's bound port.
+- ``export.http_port`` — the live ``/metrics`` endpoint's bound port;
+- ``serve.queue_depth`` / ``serve.load_level`` / ``serve.shed_rate`` /
+  ``serve.lost_requests`` / ``serve.p99_latency_seconds`` — service
+  health, refreshed on every drain; ``serve.latency_seconds`` is a
+  ``{"p50": …, "p95": …, "p99": …}`` dict that ``obs.export`` renders as
+  a Prometheus summary with quantile labels.
 """
 
 from __future__ import annotations

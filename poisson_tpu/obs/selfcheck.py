@@ -221,11 +221,40 @@ def run_selfcheck(out_dir: str) -> int:
     if slowed["verdict"] != "regression":
         return _fail(f"sentinel missed a 2x slowdown: {slowed}")
 
+    # 11. Solve service → chaos → metrics export, end to end: one chaos
+    # scenario (which RESETS the metrics registry — deliberately last,
+    # after every snapshot-dependent check above), its no-lost-request
+    # invariant read from the scenario's own metrics snapshot, and the
+    # serve.* counters surviving the Prometheus exposition round trip.
+    from poisson_tpu.testing import chaos
+
+    report = chaos.run_scenario("overload-shed", seed=0)
+    if not report["ok"]:
+        failed = [k for k, v in report["checks"].items() if not v]
+        return _fail(f"chaos scenario overload-shed failed: {failed}")
+    if report["invariant"]["lost"] != 0:
+        return _fail(f"chaos scenario lost requests: "
+                     f"{report['invariant']}")
+    serve_text = export.render(report["metrics_snapshot"])
+    serve_parsed = export.parse_text(serve_text)
+    admitted = serve_parsed.get("poisson_tpu_serve_admitted")
+    if (not admitted
+            or admitted["value"] != report["invariant"]["admitted"]):
+        return _fail(f"exposition lost the serve.admitted counter: "
+                     f"{admitted}")
+    p99_key = 'poisson_tpu_serve_latency_seconds{quantile="0.99"}'
+    if (p99_key not in serve_parsed
+            or serve_parsed[p99_key]["type"] != "summary"):
+        return _fail("exposition lost the serve latency summary "
+                     f"(looked for {p99_key})")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
           f"{n_profile_files} profile files, {len(parsed)} exposition "
-          f"metrics, sentinel ok ({out_dir})")
+          f"metrics, sentinel ok, chaos overload-shed ok "
+          f"({report['invariant']['admitted']} admitted, 0 lost) "
+          f"({out_dir})")
     return 0
 
 

@@ -1,0 +1,54 @@
+"""Resilient solve service: the request-lifecycle layer over the solvers.
+
+PR 1 made a *single* solve survivable (divergence recovery, hardened
+checkpoints, watchdog); PR 3 made *many* solves cheap (batched multi-RHS
+dispatch). This package makes batched solves survivable **as a
+service**: bounded admission with typed shedding, per-request deadlines
+propagated into chunked solves, retry with exponential backoff + jitter
+and poisoned-member bucket isolation, a circuit breaker per
+(grid, dtype, backend) cohort, and a documented graceful-degradation
+ladder — every mechanism audible as ``serve.*`` counters/spans
+(``poisson_tpu.obs``) and exportable to Prometheus (``obs.export``).
+
+The load-bearing invariant, asserted by the chaos campaign
+(``poisson_tpu.testing.chaos``; ``python -m poisson_tpu chaos --all``):
+every admitted request terminates with exactly one typed outcome —
+result, typed error, or typed shed. ``admitted − (completed + errors +
+shed) == 0``; no request is ever silently lost.
+
+    from poisson_tpu.serve import SolveRequest, SolveService
+    svc = SolveService()
+    svc.submit(SolveRequest(request_id=0, problem=Problem(M=40, N=40)))
+    outcomes = svc.drain()
+"""
+
+from poisson_tpu.serve.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from poisson_tpu.serve.deadline import Deadline
+from poisson_tpu.serve.service import SolveService
+from poisson_tpu.serve.types import (
+    ERROR_DIVERGENCE,
+    ERROR_INTERNAL,
+    ERROR_TRANSIENT,
+    OUTCOME_ERROR,
+    OUTCOME_RESULT,
+    OUTCOME_SHED,
+    BreakerPolicy,
+    DegradationPolicy,
+    Outcome,
+    RetryPolicy,
+    ServicePolicy,
+    SHED_BREAKER_OPEN,
+    SHED_DEADLINE_EXPIRED,
+    SHED_QUEUE_FULL,
+    SolveRequest,
+    TransientDispatchError,
+)
+
+__all__ = [
+    "BreakerPolicy", "CircuitBreaker", "CLOSED", "Deadline",
+    "DegradationPolicy", "ERROR_DIVERGENCE", "ERROR_INTERNAL",
+    "ERROR_TRANSIENT", "HALF_OPEN", "OPEN", "Outcome", "OUTCOME_ERROR",
+    "OUTCOME_RESULT", "OUTCOME_SHED", "RetryPolicy", "ServicePolicy",
+    "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED", "SHED_QUEUE_FULL",
+    "SolveRequest", "SolveService", "TransientDispatchError",
+]

@@ -1,0 +1,575 @@
+"""The solve service: request lifecycle over the existing solvers.
+
+Everything below PR 3's batched dispatch already exists — one traced
+program, hundreds of Poisson problems per dispatch — but a fault
+mid-batch lost every co-batched request with it, and nothing bounded how
+much work could pile up behind a wedged cohort. This module adds the
+request level (the shape Orca, PAPERS.md, gives a serving stack):
+
+- **bounded admission** — a queue of at most ``policy.capacity``
+  requests; admission beyond it is a typed ``queue_full`` shed, never
+  unbounded growth;
+- **deadlines** — propagated into chunked solves (chunk-boundary checks,
+  ``solvers.checkpoint``); expiry returns the partial iterate flagged
+  ``deadline``, and a request whose budget dies while queued is shed
+  without burning a dispatch;
+- **retry with exponential backoff + jitter** — transient dispatch
+  faults re-enqueue every member into a *different* bucket (mutual
+  taint: one poisoned member cannot re-kill its batchmates);
+  divergence-class member failures escalate through the self-healing
+  driver (``solvers.resilient``);
+- **circuit breaking** — per (grid, dtype, backend) cohort
+  (``serve.breaker``), trip / cooldown / half-open probes;
+- **graceful degradation** — the documented policy ladder
+  (``types.DegradationPolicy``) driven by queue depth, every step
+  audible as ``serve.degraded.*`` counters;
+- **the ledger invariant** — every admitted request terminates with
+  exactly one typed outcome; ``stats()['lost']`` is computed, asserted
+  by the chaos campaign, and exported with the ``serve.*`` counters.
+
+The service is deliberately single-threaded and clock/sleep-injectable:
+the dispatch loop IS the unit under chaos test, and determinism (seeded
+jitter, virtual clocks) is what makes the chaos campaign a regression
+suite instead of a flake generator.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from poisson_tpu import obs
+from poisson_tpu.serve.breaker import CircuitBreaker
+from poisson_tpu.serve.deadline import Deadline
+from poisson_tpu.serve.types import (
+    ERROR_DIVERGENCE,
+    ERROR_INTERNAL,
+    ERROR_TRANSIENT,
+    OUTCOME_ERROR,
+    OUTCOME_RESULT,
+    OUTCOME_SHED,
+    Outcome,
+    ServicePolicy,
+    SHED_BREAKER_OPEN,
+    SHED_DEADLINE_EXPIRED,
+    SHED_QUEUE_FULL,
+    SolveRequest,
+    TransientDispatchError,
+)
+
+
+class _Entry:
+    """Queue-resident lifecycle state for one admitted request."""
+
+    __slots__ = ("request", "admitted_at", "deadline", "attempts",
+                 "taint", "not_before", "escalate", "last_failure")
+
+    def __init__(self, request: SolveRequest, admitted_at: float,
+                 deadline: Optional[Deadline]):
+        self.request = request
+        self.admitted_at = admitted_at
+        self.deadline = deadline
+        self.attempts = 0          # dispatches so far
+        self.taint: set = set()    # request_ids never to co-batch with again
+        self.not_before = 0.0      # backoff gate (service clock)
+        self.escalate = False      # next dispatch via the resilient driver
+        self.last_failure = ""
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(np.ceil(q * len(sorted_vals))) - 1))
+    return float(sorted_vals[idx])
+
+
+class SolveService:
+    """Single-process solve service over the JAX solver stack.
+
+    ``submit`` admits a request (or sheds it, typed, immediately);
+    ``drain`` runs the dispatch loop until every admitted request has its
+    outcome. ``clock``/``sleep`` default to real monotonic time; chaos
+    scenarios inject a :class:`testing.chaos.VirtualClock` pair.
+    ``dispatch_fault`` is the service-level fault seam: called with the
+    entry batch immediately before the solver runs, it may raise
+    :class:`TransientDispatchError` (a device-level batch kill) or stall
+    on the injected clock (a slow worker).
+    """
+
+    def __init__(self, policy: Optional[ServicePolicy] = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Optional[Callable[[float], None]] = None,
+                 seed: int = 0,
+                 dispatch_fault: Optional[Callable] = None):
+        self.policy = policy or ServicePolicy()
+        if self.policy.capacity < 1:
+            raise ValueError("service capacity must be >= 1")
+        if self.policy.retry.max_attempts < 1:
+            raise ValueError("retry.max_attempts must be >= 1")
+        self._clock = clock
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._rng = random.Random(seed)
+        self._dispatch_fault = dispatch_fault
+        self._queue: deque = deque()
+        self._delayed: List[_Entry] = []
+        self._pending_ids: set = set()  # ids queued or backing off
+        self._breakers: dict = {}
+        self._outcomes: dict = {}
+        self._order: List = []          # outcome completion order
+        self._latencies: List[float] = []
+        self._counts = {"admitted": 0, "completed": 0, "errors": 0,
+                        "shed": 0}
+
+    # -- admission -----------------------------------------------------
+
+    def submit(self, request: SolveRequest) -> Optional[Outcome]:
+        """Admit ``request`` into the ledger. Returns the typed Outcome
+        immediately iff the request was shed at admission (queue full);
+        None when it was queued — its outcome arrives via :meth:`drain`.
+        Either way the request is admitted for accounting: exactly one
+        typed outcome will exist for it."""
+        if (request.request_id in self._outcomes
+                or request.request_id in self._pending_ids):
+            raise ValueError(
+                f"duplicate request_id {request.request_id!r} — the "
+                "one-outcome-per-request ledger needs unique ids"
+            )
+        self._counts["admitted"] += 1
+        obs.inc("serve.admitted")
+        now = self._clock()
+        deadline = (Deadline(request.deadline_seconds, clock=self._clock)
+                    if request.deadline_seconds is not None else None)
+        entry = _Entry(request, now, deadline)
+        depth = len(self._queue) + len(self._delayed)
+        if depth >= self.policy.capacity:
+            return self._shed(entry, SHED_QUEUE_FULL,
+                              "admission queue at capacity "
+                              f"({self.policy.capacity})")
+        self._pending_ids.add(request.request_id)
+        self._queue.append(entry)
+        obs.gauge("serve.queue_depth", len(self._queue) + len(self._delayed))
+        return None
+
+    # -- lifecycle loop ------------------------------------------------
+
+    def drain(self) -> List[Outcome]:
+        """Run the dispatch loop until no admitted request is pending;
+        returns every outcome reached during this drain, in completion
+        order. Publishes the ``serve.*`` stats gauges afterwards."""
+        start = len(self._order)
+        while self._step():
+            pass
+        self._publish_stats()
+        return [self._outcomes[rid] for rid in self._order[start:]]
+
+    def _step(self) -> bool:
+        self._pump_delayed()
+        if not self._queue:
+            if not self._delayed:
+                return False
+            # Everything pending is backing off: advance to the earliest
+            # ready time (virtual clocks advance instantly; real clocks
+            # sleep). Force-promote afterwards so a coarse injected clock
+            # can never wedge the loop.
+            wait = max(0.0, min(e.not_before for e in self._delayed)
+                       - self._clock())
+            self._sleep(wait)
+            self._pump_delayed()
+            if not self._queue and self._delayed:
+                self._delayed.sort(key=lambda e: e.not_before)
+                self._queue.append(self._delayed.pop(0))
+        head = self._queue.popleft()
+        if head.deadline is not None and head.deadline.expired():
+            obs.inc("serve.deadline.expired_in_queue")
+            self._shed(head, SHED_DEADLINE_EXPIRED,
+                       "deadline expired while queued")
+            return True
+        # Load is measured at dispatch-cycle start (head included), BEFORE
+        # batch formation empties the queue — degradation responds to the
+        # pressure the service is under, not to the hole a big batch just
+        # carved out of it.
+        level = self._load_level(len(self._queue) + len(self._delayed) + 1)
+        batch = self._form_batch(head)
+        breaker = self._breaker(self._cohort(head.request))
+        if not breaker.allow():
+            for entry in batch:
+                self._shed(entry, SHED_BREAKER_OPEN,
+                           f"circuit breaker open for cohort "
+                           f"{self._cohort(entry.request)}")
+            return True
+        self._dispatch(batch, breaker, level)
+        return True
+
+    def _pump_delayed(self) -> None:
+        now = self._clock()
+        ready = [e for e in self._delayed if e.not_before <= now]
+        if ready:
+            self._delayed = [e for e in self._delayed
+                             if e.not_before > now]
+            self._queue.extend(ready)
+
+    # -- batching ------------------------------------------------------
+
+    def _cohort(self, request: SolveRequest) -> str:
+        p = request.problem
+        return f"{p.M}x{p.N}:{request.dtype or 'auto'}:xla"
+
+    def _breaker(self, cohort: str) -> CircuitBreaker:
+        if cohort not in self._breakers:
+            self._breakers[cohort] = CircuitBreaker(
+                self.policy.breaker, clock=self._clock, cohort=cohort)
+        return self._breakers[cohort]
+
+    def _solo(self, entry: _Entry) -> bool:
+        """Chunked single-request dispatch classes: deadline-carrying
+        (expiry needs chunk boundaries), explicitly chunked, or escalated
+        divergence retries (the resilient driver is single-request)."""
+        return (entry.deadline is not None
+                or entry.request.chunk is not None
+                or entry.escalate)
+
+    def _form_batch(self, head: _Entry) -> List[_Entry]:
+        if self._solo(head):
+            return [head]
+        cohort = self._cohort(head.request)
+        batch = [head]
+        ids = {head.request.request_id}
+        taints = set(head.taint)
+        kept = deque()
+        while self._queue and len(batch) < self.policy.max_batch:
+            e = self._queue.popleft()
+            compatible = (
+                not self._solo(e)
+                and self._cohort(e.request) == cohort
+                and e.request.request_id not in taints
+                and not (ids & e.taint)
+            )
+            if compatible:
+                batch.append(e)
+                ids.add(e.request.request_id)
+                taints |= e.taint
+            else:
+                kept.append(e)
+        kept.extend(self._queue)
+        self._queue = kept
+        return batch
+
+    def _load_level(self, depth: int) -> int:
+        frac = depth / self.policy.capacity
+        d = self.policy.degradation
+        if frac >= d.downshift_precision_at:
+            return 3
+        if frac >= d.cap_iterations_at:
+            return 2
+        if frac >= d.shrink_padding_at:
+            return 1
+        return 0
+
+    # -- dispatch ------------------------------------------------------
+
+    def _dispatch(self, batch: List[_Entry], breaker: CircuitBreaker,
+                  level: int) -> None:
+        from poisson_tpu.solvers.pcg import resolve_dtype
+
+        policy = self.policy
+        obs.gauge("serve.load_level", level)
+        head = batch[0]
+        problem = head.request.problem
+        dtype = head.request.dtype
+        exact_bucket = False
+        if level >= 1:
+            exact_bucket = True
+            obs.inc("serve.degraded.padding")
+        if level >= 2:
+            cap = min(problem.iteration_cap,
+                      policy.degradation.degraded_iteration_cap)
+            problem = problem.with_(max_iter=cap)
+            obs.inc("serve.degraded.iteration_cap")
+        if level >= 3 and resolve_dtype(dtype) == "float64":
+            dtype = "float32"
+            obs.inc("serve.degraded.precision")
+        if level > 0:
+            obs.event("serve.degraded", level=level,
+                      batch=len(batch), exact_bucket=exact_bucket,
+                      iteration_cap=problem.iteration_cap, dtype=dtype)
+
+        obs.inc("serve.dispatches")
+        obs.inc("serve.batch_members", len(batch))
+        cohort = self._cohort(head.request)
+        try:
+            with obs.span("serve.dispatch", fence=False, cohort=cohort,
+                          batch=len(batch), level=level):
+                if self._dispatch_fault is not None:
+                    self._dispatch_fault([e.request for e in batch],
+                                         {e.request.request_id: e.attempts
+                                          for e in batch})
+                if len(batch) == 1 and self._solo(head):
+                    member_failed = self._dispatch_solo(head, problem,
+                                                        dtype)
+                else:
+                    member_failed = self._dispatch_batched(
+                        batch, problem, dtype, exact_bucket)
+        except TransientDispatchError as e:
+            breaker.record_failure()
+            co_ids = {entry.request.request_id for entry in batch}
+            for entry in batch:
+                self._retry_or_fail(entry, ERROR_TRANSIENT, str(e),
+                                    co_ids - {entry.request.request_id})
+            return
+        except Exception as e:  # internal: surfaced, never retried
+            breaker.record_failure()
+            for entry in batch:
+                self._error(entry, ERROR_INTERNAL,
+                            f"{type(e).__name__}: {e}")
+            return
+        if member_failed:
+            breaker.record_failure()
+        else:
+            breaker.record_success()
+
+    def _dispatch_batched(self, batch: List[_Entry], problem, dtype,
+                          exact_bucket: bool) -> bool:
+        from poisson_tpu.solvers.batched import solve_batched
+
+        result = solve_batched(
+            problem,
+            rhs_gates=[e.request.rhs_gate for e in batch],
+            member_ids=[e.request.request_id for e in batch],
+            dtype=dtype,
+            bucket=(len(batch) if exact_bucket else None),
+        )
+        co_ids = {e.request.request_id for e in batch}
+        iters = np.asarray(result.iterations)
+        flags = np.asarray(result.flag)
+        diffs = np.asarray(result.diff)
+        any_failed = False
+        for i, entry in enumerate(batch):
+            assert result.origin[i] == entry.request.request_id
+            failed = self._classify_member(
+                entry, int(flags[i]), int(iters[i]), float(diffs[i]),
+                restarts=0, cap=problem.iteration_cap,
+                co_ids=co_ids - {entry.request.request_id},
+            )
+            any_failed = any_failed or failed
+        return any_failed
+
+    def _dispatch_solo(self, entry: _Entry, problem, dtype) -> bool:
+        from poisson_tpu.solvers.checkpoint import pcg_solve_chunked
+        from poisson_tpu.solvers.resilient import (
+            DivergenceError,
+            pcg_solve_resilient,
+        )
+
+        req = entry.request
+        chunk = req.chunk or self.policy.default_chunk
+        # The RHS gate folds into f_val so both solo drivers see it the
+        # same way (the batched path uses rhs_gates for the shared-setup
+        # win; a solo dispatch has nothing to share).
+        solo_problem = problem.with_(f_val=problem.f_val * req.rhs_gate)
+        if entry.escalate and self.policy.retry.escalate_divergence:
+            obs.inc("serve.escalations")
+            try:
+                result = pcg_solve_resilient(
+                    solo_problem, dtype=dtype, chunk=chunk,
+                    deadline=entry.deadline, on_chunk=req.on_chunk,
+                )
+            except DivergenceError as e:
+                self._error(entry, ERROR_DIVERGENCE, str(e))
+                return True
+        else:
+            result = pcg_solve_chunked(
+                solo_problem, chunk=chunk, dtype=dtype,
+                deadline=entry.deadline, on_chunk=req.on_chunk,
+            )
+        return self._classify_member(
+            entry, int(result.flag), int(result.iterations),
+            float(np.max(np.asarray(result.diff))),
+            restarts=int(getattr(result, "restarts", 0) or 0),
+            cap=problem.iteration_cap, co_ids=set(),
+        )
+
+    # -- outcome classification ----------------------------------------
+
+    def _classify_member(self, entry: _Entry, flag: int, iterations: int,
+                         diff: float, restarts: int, cap: int,
+                         co_ids: set) -> bool:
+        """Turn one member's stop verdict into an outcome or a retry.
+        Returns True iff this member counts as a dispatch failure for the
+        breaker."""
+        from poisson_tpu.solvers.pcg import (
+            FLAG_CONVERGED,
+            FLAG_DEADLINE,
+            FLAG_NAMES,
+            FLAG_NONE,
+        )
+
+        name = FLAG_NAMES.get(flag, str(flag))
+        if flag == FLAG_CONVERGED:
+            self._complete(entry, name, True, False, iterations, restarts,
+                           diff)
+            return False
+        if flag == FLAG_DEADLINE:
+            obs.inc("serve.deadline.expired_mid_solve")
+            self._complete(entry, name, False, True, iterations, restarts,
+                           diff)
+            return False
+        if flag == FLAG_NONE:
+            # Budget exhausted without a failure verdict (incl. the
+            # degraded iteration cap): the partial iterate is the answer
+            # the policy bought.
+            self._complete(entry, "cap_hit", False, True, iterations,
+                           restarts, diff)
+            return False
+        # breakdown / nonfinite / stagnated: divergence-class failure.
+        self._retry_or_fail(entry, ERROR_DIVERGENCE,
+                            f"solver stopped: {name} at iteration "
+                            f"{iterations}", co_ids)
+        return True
+
+    def _retry_or_fail(self, entry: _Entry, error_type: str, message: str,
+                       co_ids: set) -> None:
+        entry.attempts += 1
+        entry.last_failure = error_type
+        max_attempts = (entry.request.max_attempts
+                        or self.policy.retry.max_attempts)
+        if entry.attempts >= max_attempts:
+            self._error(entry, error_type,
+                        f"{message} (attempt {entry.attempts}/"
+                        f"{max_attempts})")
+            return
+        delay = self._backoff_delay(entry.attempts)
+        if entry.deadline is not None:
+            remaining = entry.deadline.remaining()
+            if remaining is not None and remaining <= delay:
+                obs.inc("serve.deadline.expired_in_queue")
+                self._shed(entry, SHED_DEADLINE_EXPIRED,
+                           f"deadline cannot survive the {delay:.3f}s "
+                           f"retry backoff after: {message}")
+                return
+        # Mutual taint: this member never shares a bucket with its failed
+        # batchmates again (and vice versa, applied on their entries) —
+        # a poisoned member cannot re-kill the same cohort twice.
+        entry.taint |= co_ids
+        entry.escalate = (error_type == ERROR_DIVERGENCE
+                          and self.policy.retry.escalate_divergence)
+        entry.not_before = self._clock() + delay
+        obs.inc("serve.retries")
+        obs.inc("serve.backoff_seconds", delay)
+        if co_ids:
+            obs.inc("serve.requeued.isolated")
+        obs.event("serve.retry", request_id=str(entry.request.request_id),
+                  attempt=entry.attempts, delay=round(delay, 4),
+                  error=error_type, escalate=entry.escalate)
+        self._delayed.append(entry)
+
+    def _backoff_delay(self, attempt: int) -> float:
+        r = self.policy.retry
+        base = min(r.backoff_base * (2 ** (attempt - 1)), r.backoff_cap)
+        # Jitter over [1-jitter, 1]: decorrelates retries without ever
+        # exceeding the cap. Seeded RNG — deterministic campaigns.
+        return base * (1.0 - r.jitter * self._rng.random())
+
+    # -- outcome recording ---------------------------------------------
+
+    def _record(self, outcome: Outcome) -> Outcome:
+        self._pending_ids.discard(outcome.request_id)
+        self._outcomes[outcome.request_id] = outcome
+        self._order.append(outcome.request_id)
+        self._latencies.append(outcome.latency_seconds)
+        obs.gauge("serve.queue_depth",
+                  len(self._queue) + len(self._delayed))
+        return outcome
+
+    def _latency(self, entry: _Entry) -> float:
+        return max(0.0, self._clock() - entry.admitted_at)
+
+    def _complete(self, entry: _Entry, flag: str, converged: bool,
+                  partial: bool, iterations: int, restarts: int,
+                  diff: float) -> Outcome:
+        self._counts["completed"] += 1
+        obs.inc("serve.completed")
+        if partial:
+            obs.inc("serve.completed.partial")
+        if restarts:
+            obs.inc("serve.completed.recovered")
+        return self._record(Outcome(
+            request_id=entry.request.request_id, kind=OUTCOME_RESULT,
+            flag=flag, converged=converged, partial=partial,
+            iterations=iterations, restarts=restarts,
+            attempts=entry.attempts + 1,
+            latency_seconds=self._latency(entry), diff=diff,
+        ))
+
+    def _error(self, entry: _Entry, error_type: str, message: str
+               ) -> Outcome:
+        self._counts["errors"] += 1
+        obs.inc("serve.errors")
+        obs.inc(f"serve.errors.{error_type}")
+        obs.event("serve.error", request_id=str(entry.request.request_id),
+                  error=error_type, message=message[:200])
+        return self._record(Outcome(
+            request_id=entry.request.request_id, kind=OUTCOME_ERROR,
+            error_type=error_type, message=message,
+            attempts=max(1, entry.attempts),
+            latency_seconds=self._latency(entry),
+        ))
+
+    def _shed(self, entry: _Entry, reason: str, message: str) -> Outcome:
+        self._counts["shed"] += 1
+        obs.inc("serve.shed")
+        obs.inc(f"serve.shed.{reason}")
+        obs.event("serve.shed", request_id=str(entry.request.request_id),
+                  reason=reason)
+        return self._record(Outcome(
+            request_id=entry.request.request_id, kind=OUTCOME_SHED,
+            shed_reason=reason, message=message,
+            attempts=entry.attempts,
+            latency_seconds=self._latency(entry),
+        ))
+
+    # -- accounting ----------------------------------------------------
+
+    def outcomes(self) -> List[Outcome]:
+        """Every outcome so far, in completion order."""
+        return [self._outcomes[rid] for rid in self._order]
+
+    def stats(self) -> dict:
+        """The ledger: admitted vs terminated (the no-lost-request
+        invariant is ``lost == 0`` once the queue is drained), latency
+        percentiles on the service clock, and the shed rate."""
+        c = dict(self._counts)
+        pending = len(self._queue) + len(self._delayed)
+        lats = sorted(self._latencies)
+        return {
+            "admitted": c["admitted"],
+            "completed": c["completed"],
+            "errors": c["errors"],
+            "shed": c["shed"],
+            "pending": pending,
+            "lost": c["admitted"] - (c["completed"] + c["errors"]
+                                     + c["shed"]) - pending,
+            "latency_seconds": {
+                "p50": _percentile(lats, 0.50),
+                "p95": _percentile(lats, 0.95),
+                "p99": _percentile(lats, 0.99),
+            },
+            "shed_rate": (c["shed"] / c["admitted"] if c["admitted"]
+                          else 0.0),
+            "breakers": {cohort: b.state
+                         for cohort, b in self._breakers.items()},
+        }
+
+    def _publish_stats(self) -> None:
+        s = self.stats()
+        obs.gauge("serve.latency_seconds", s["latency_seconds"])
+        obs.gauge("serve.p99_latency_seconds",
+                  s["latency_seconds"]["p99"])
+        obs.gauge("serve.shed_rate", round(s["shed_rate"], 6))
+        obs.gauge("serve.queue_depth", s["pending"])
+        obs.gauge("serve.lost_requests", s["lost"])

@@ -1,0 +1,171 @@
+"""Typed request/outcome taxonomy for the solve service.
+
+The central invariant the whole ``poisson_tpu.serve`` layer exists to
+uphold: **every admitted request terminates with exactly one typed
+outcome** — a result (possibly partial), a typed error, or a typed shed.
+No request is ever silently lost, and nothing about a request's fate has
+to be reconstructed from logs: the outcome object says what happened,
+after how many attempts, and in how long.
+
+Outcome kinds:
+
+- ``result`` — a solution grid came back. ``converged`` says whether it
+  met δ; a deadline or degraded-iteration-cap stop returns the partial
+  iterate with ``partial=True`` and the stop verdict in ``flag``
+  (``solvers.pcg.FLAG_NAMES``) rather than pretending to have failed —
+  the partial iterate of an elliptic solve is a usable warm start.
+- ``error`` — the service gave up after its retry/escalation budget:
+  ``error_type`` ∈ ``divergence`` (recovery exhausted, see
+  ``solvers.resilient.DivergenceError``), ``transient`` (dispatch kept
+  failing — device fault, injected chaos), ``internal`` (a bug; never
+  retried, always surfaced).
+- ``shed`` — the service refused the work, by policy, with a reason:
+  ``queue_full`` (bounded admission queue — overload never becomes
+  unbounded memory growth), ``breaker_open`` (the request's cohort is
+  circuit-broken), ``deadline_expired`` (the budget ran out while the
+  request was still queued — dispatching it would burn capacity on an
+  answer nobody is waiting for).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Union
+
+from poisson_tpu.config import Problem
+
+OUTCOME_RESULT = "result"
+OUTCOME_ERROR = "error"
+OUTCOME_SHED = "shed"
+
+ERROR_DIVERGENCE = "divergence"
+ERROR_TRANSIENT = "transient"
+ERROR_INTERNAL = "internal"
+
+SHED_QUEUE_FULL = "queue_full"
+SHED_BREAKER_OPEN = "breaker_open"
+SHED_DEADLINE_EXPIRED = "deadline_expired"
+
+
+class TransientDispatchError(RuntimeError):
+    """A dispatch-level fault that poisoned the whole batch (device
+    crash, wedged transfer, injected chaos). Retryable: the service
+    re-enqueues every member into a *different* bucket — one poisoned
+    member must not re-kill its batchmates on the retry."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SolveRequest:
+    """One Poisson solve as a service request.
+
+    ``rhs_gate`` scales the problem's RHS (the multi-tenant knob: many
+    requests share one operator cohort and differ in forcing). Requests
+    whose ``deadline_seconds``/``chunk`` is set are dispatched through
+    the chunked single-request path (deadlines need chunk boundaries to
+    be enforceable); the rest ride the batched multi-RHS path.
+    ``on_chunk`` is the fault-injection seam (``testing.faults``) for
+    chunked dispatches — None in production.
+    """
+
+    request_id: Union[int, str]
+    problem: Problem
+    rhs_gate: float = 1.0
+    dtype: Optional[str] = None
+    deadline_seconds: Optional[float] = None
+    chunk: Optional[int] = None
+    max_attempts: Optional[int] = None
+    on_chunk: Optional[Callable] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Outcome:
+    """The one typed terminal record of a request's lifecycle."""
+
+    request_id: Union[int, str]
+    kind: str                     # result | error | shed
+    flag: str = ""                # stop verdict name (result outcomes)
+    converged: bool = False
+    partial: bool = False         # deadline/cap-stopped result
+    iterations: int = 0
+    restarts: int = 0             # recovery attempts inside the solve
+    attempts: int = 1             # service-level dispatch attempts
+    latency_seconds: float = 0.0  # admission → outcome, service clock
+    error_type: str = ""          # divergence | transient | internal
+    shed_reason: str = ""         # queue_full | breaker_open | deadline_expired
+    message: str = ""
+    diff: Optional[float] = None  # final ‖Δw‖ (result outcomes)
+
+    @property
+    def ok(self) -> bool:
+        return self.kind == OUTCOME_RESULT and self.converged
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Retry-with-exponential-backoff-and-jitter for retryable failures.
+
+    ``max_attempts`` counts dispatches (1 = never retry). Backoff delay
+    for attempt *n* (1-based) is
+    ``min(backoff_base · 2^(n−1), backoff_cap)``, jittered over
+    ``[1 − jitter, 1]`` by the service's seeded RNG — deterministic under
+    a fixed seed, decorrelated across requests. ``escalate_divergence``
+    routes a divergence-class retry through the self-healing driver
+    (``solvers.resilient``: restart from last good iterate, precision
+    escalation) instead of a plain re-dispatch.
+    """
+
+    max_attempts: int = 3
+    backoff_base: float = 0.05
+    backoff_cap: float = 2.0
+    jitter: float = 0.5
+    escalate_divergence: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class BreakerPolicy:
+    """Per-cohort circuit breaker: trip after ``failure_threshold``
+    consecutive dispatch failures, hold OPEN for ``cooldown_seconds``,
+    then HALF_OPEN with ``half_open_probes`` probe dispatches."""
+
+    failure_threshold: int = 3
+    cooldown_seconds: float = 5.0
+    half_open_probes: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradationPolicy:
+    """The graceful-degradation policy ladder, driven by queue depth as a
+    fraction of capacity. Each engaged step is audible as a
+    ``serve.degraded.*`` counter and event — degradation that cannot be
+    seen in the metrics is indistinguishable from silent data loss.
+
+    1. ``shrink_padding_at`` — dispatch exact-size batches instead of
+       power-of-two buckets: no padding-member work when every real
+       member counts (costs executable-cache reuse, buys latency).
+    2. ``cap_iterations_at`` — cap ``max_iterations`` at
+       ``degraded_iteration_cap``: slow-converging requests return
+       partial results instead of holding the queue hostage.
+    3. ``downshift_precision_at`` — downshift float64 requests to
+       float32 (symmetrically-scaled fp32 reproduces fp64 iteration
+       counts on this problem class — README "Precision policy").
+    """
+
+    shrink_padding_at: float = 0.5
+    cap_iterations_at: float = 0.75
+    degraded_iteration_cap: int = 256
+    downshift_precision_at: float = 0.9
+
+
+@dataclasses.dataclass(frozen=True)
+class ServicePolicy:
+    """Top-level service knobs: bounded queue ``capacity`` (admission
+    beyond it sheds — typed, immediate, never unbounded growth),
+    ``max_batch`` members per fused dispatch, ``default_chunk``
+    iterations between deadline checks on chunked dispatches."""
+
+    capacity: int = 64
+    max_batch: int = 32
+    default_chunk: int = 50
+    retry: RetryPolicy = RetryPolicy()
+    breaker: BreakerPolicy = BreakerPolicy()
+    degradation: DegradationPolicy = DegradationPolicy()

@@ -177,7 +177,8 @@ def _count_bucket(key: tuple, batch: int, bucket: int) -> None:
 def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
                   dtype=None, scaled=None, mesh=None,
                   buckets: Sequence[int] = DEFAULT_BUCKETS,
-                  bucket: Optional[int] = None) -> PCGResult:
+                  bucket: Optional[int] = None,
+                  member_ids: Optional[Sequence] = None) -> PCGResult:
     """Solve a batch of Poisson problems in one fused device program.
 
     Input forms (exactly one):
@@ -205,6 +206,15 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     ``dtype``/``scaled`` follow ``pcg_solve``'s precision policy. ``mesh``
     is rejected: the batch axis must be vmapped OUTSIDE ``shard_map``, and
     that composition is not wired up yet.
+
+    ``member_ids`` (optional, one hashable id per member) rides through
+    padding and slicing onto ``PCGResult.origin``, so position ``i`` of
+    every returned per-member field is attributable to ``origin[i]`` no
+    matter how the batch was padded or re-formed. Default: ``(0, …, B−1)``.
+    This is the requeue seam the solve service (``poisson_tpu.serve``)
+    needs — a member re-enqueued into a *different* bucket after a fault
+    keeps its request identity — and is useful standalone (aggregate
+    bucket stats are no longer the only per-dispatch record).
     """
     if mesh is not None:
         raise ValueError(
@@ -291,6 +301,16 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
             # full grid (zero ring), so one broadcast multiply.
             rhs_stack = rhs_stack * aux
 
+    if member_ids is not None:
+        origin = tuple(member_ids)
+        if len(origin) != batch:
+            raise ValueError(
+                f"member_ids must have one id per member: got "
+                f"{len(origin)} ids for batch {batch}"
+            )
+    else:
+        origin = tuple(range(batch))
+
     size = bucket_size(batch, buckets) if bucket is None else int(bucket)
     if size < batch:
         raise ValueError(f"bucket {size} smaller than batch {batch}")
@@ -307,10 +327,11 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
 
     result = _solve_batched(jit_problem, use_scaled, a, b, rhs_stack, aux)
     if size == batch:
-        return result
+        return result._replace(origin=origin)
     # Slice padding members off every batched field; max_iterations is
     # recomputed over the real members (padding stops at k=1, so the
     # fused-loop max is unchanged unless every member was padding).
+    # ``origin`` was never padded — position i stays member_ids[i].
     return PCGResult(
         w=result.w[:batch],
         iterations=result.iterations[:batch],
@@ -318,6 +339,7 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
         residual_dot=result.residual_dot[:batch],
         flag=result.flag[:batch],
         max_iterations=jnp.max(result.iterations[:batch]),
+        origin=origin,
     )
 
 

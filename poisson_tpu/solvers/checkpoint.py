@@ -46,6 +46,8 @@ from jax import lax
 from poisson_tpu.config import Problem
 from poisson_tpu.solvers.pcg import (
     FLAG_CONVERGED,
+    FLAG_DEADLINE,
+    FLAG_NONE,
     FLAG_NONFINITE,
     PCGResult,
     PCGState,
@@ -125,19 +127,33 @@ def _converged(state) -> bool:
     return True if flag is None else flag == FLAG_CONVERGED
 
 
-def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
+def run_chunked(state, *, advance, to_portable, path: Optional[str],
+                fingerprint: str,
                 cap: int, keep_checkpoint: bool, primary=None, sync=None,
-                keep_last: int = 2, watchdog=None, on_chunk=None):
+                keep_last: int = 2, watchdog=None, on_chunk=None,
+                deadline=None):
     """The one chunked-checkpoint driver loop, shared by all four
     checkpointed solvers (single/sharded × XLA/fused): advance until done
     or cap, persist the portable full-grid state after every chunk, clean
     up a *converged* run's checkpoint (a cap-hit keeps it for resume).
+    ``path=None`` runs the same loop persistence-free (the deadline-only
+    chunked mode the solve service uses — see :func:`pcg_solve_chunked`).
 
     ``state`` must expose ``.done`` and ``.k``; ``advance(state)`` runs one
     chunk; ``to_portable(state)`` produces the PCGState ``save_state``
     writes. ``primary``/``sync`` gate the file write to one process and
     barrier-order it against other processes' later reads (multi-process
     meshes); they default to single-process no-ops.
+
+    ``deadline`` (duck-typed: anything with ``expired() -> bool``, e.g.
+    ``poisson_tpu.serve.Deadline``) makes the chunking deadline-aware: the
+    loop refuses to START a chunk once the deadline has expired, so a
+    deadlined solve returns its partial state within one chunk of the
+    cutoff instead of hanging to convergence. The caller stamps the
+    result flag (FLAG_DEADLINE); the persisted state never carries it, so
+    a later run can resume with a fresh budget. The deadline is checked
+    at chunk boundaries only — overshoot is bounded by one chunk, which
+    is what sizes ``chunk`` for deadline-sensitive callers.
 
     Resilience hooks:
 
@@ -161,6 +177,15 @@ def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
     chunks_done = 0
     try:
         while (not bool(state.done)) and int(state.k) < cap:
+            if deadline is not None and deadline.expired():
+                # Don't start a chunk the deadline has already disowned:
+                # the last persisted generation is the partial answer.
+                from poisson_tpu import obs
+
+                obs.inc("checkpoint.deadline_stops")
+                obs.event("checkpoint.deadline_stop", k=int(state.k),
+                          chunks=chunks_done)
+                break
             state = advance(state)
             jax.block_until_ready(state)
             chunks_done += 1
@@ -177,10 +202,12 @@ def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
                 # below: skip the full-grid gather (an all-gather collective
                 # on multi-process meshes) and the disk write outright.
                 break
-            portable = to_portable(state)   # collective when multi-process
-            if primary():
-                save_state(path, portable, fingerprint, keep_last=keep_last)
-            sync("poisson_ckpt_save")   # write lands before anyone reads it
+            if path:
+                portable = to_portable(state)  # collective if multi-process
+                if primary():
+                    save_state(path, portable, fingerprint,
+                               keep_last=keep_last)
+                sync("poisson_ckpt_save")  # write lands before any read
             if on_chunk is not None:
                 state = _apply_hook(on_chunk, state, chunks_done)
     except KeyboardInterrupt:
@@ -190,7 +217,7 @@ def run_chunked(state, *, advance, to_portable, path: str, fingerprint: str,
     finally:
         if watchdog is not None:
             watchdog.stop()
-    if _converged(state) and not keep_checkpoint and primary():
+    if path and _converged(state) and not keep_checkpoint and primary():
         remove_generations(path, keep_last)
     sync("poisson_ckpt_done")           # removal precedes any follow-up solve
     return state
@@ -397,6 +424,21 @@ def load_state(path: str, fingerprint: str,
     return None if found is None else found[0]
 
 
+def _deadline_flag(state, deadline):
+    """The result flag for a chunked run: the state's own verdict, or the
+    host-stamped FLAG_DEADLINE when the run was still healthy (verdict
+    ``running``) and stopped only because its deadline expired. A solve
+    that diverged (nonfinite/breakdown/stagnated) keeps that verdict even
+    when the deadline has also lapsed — stamping over it would make the
+    service hand a diverged iterate out as a usable partial result and
+    skip the retry/escalation path. Never persisted — result-only
+    provenance."""
+    if (deadline is not None and deadline.expired()
+            and _state_flag(state) in (None, FLAG_NONE)):
+        return jnp.asarray(FLAG_DEADLINE, jnp.int32)
+    return state.flag
+
+
 def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                            chunk: int = 200, dtype=None, scaled=None,
                            keep_checkpoint: bool = False,
@@ -404,7 +446,8 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                            stagnation_window: int = 0,
                            stream_every: int = 0,
                            watchdog=None,
-                           on_chunk=None) -> PCGResult:
+                           on_chunk=None,
+                           deadline=None) -> PCGResult:
     """Solve with periodic state persistence and automatic resume.
 
     Every ``chunk`` iterations the CG state is written to
@@ -414,8 +457,11 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     starting over, falling back to an older generation when the newest is
     corrupt. On convergence the checkpoint is removed unless
     ``keep_checkpoint``; a cap-hit or divergence stop (``PCGResult.flag``)
-    keeps it. ``watchdog``/``on_chunk`` are the chunk-boundary resilience
-    hooks documented on :func:`run_chunked`.
+    keeps it. ``watchdog``/``on_chunk``/``deadline`` are the
+    chunk-boundary resilience hooks documented on :func:`run_chunked`; a
+    deadline expiry returns the partial iterate with
+    ``flag == FLAG_DEADLINE`` (the checkpoint survives for a resume with
+    a fresh budget).
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -441,11 +487,58 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
         to_portable=lambda s: s,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint, keep_last=keep_last,
-        watchdog=watchdog, on_chunk=on_chunk,
+        watchdog=watchdog, on_chunk=on_chunk, deadline=deadline,
     )
 
     w = state.w * aux if use_scaled else state.w
     return PCGResult(
         w=w, iterations=state.k, diff=state.diff, residual_dot=state.zr,
-        flag=state.flag,
+        flag=_deadline_flag(state, deadline),
+    )
+
+
+def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
+                      scaled=None, rhs_gate=None,
+                      stagnation_window: int = 0, stream_every: int = 0,
+                      watchdog=None, on_chunk=None,
+                      deadline=None) -> PCGResult:
+    """Chunked single-device solve WITHOUT persistence: the same
+    chunk-boundary loop as :func:`pcg_solve_checkpointed` (watchdog beats,
+    fault hooks, deadline awareness) minus the disk. This is the dispatch
+    primitive the solve service (``poisson_tpu.serve``) uses for
+    deadline-carrying requests — a request must be interruptible at chunk
+    boundaries, but a short-lived service request has no resume story, so
+    writing checkpoints for it would just burn disk on the hot path.
+
+    Converging runs produce the exact ``pcg_solve`` iterate sequence
+    (chunking never changes the iterates, only where the host observes
+    them). ``rhs_gate`` mirrors ``pcg_solve``'s RHS multiplier. A deadline
+    expiry returns the partial iterate with ``flag == FLAG_DEADLINE``.
+    """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+    if rhs_gate is not None:
+        rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if use_scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    state = run_chunked(
+        init_state(ops, rhs),
+        advance=lambda s: _run_chunk(problem, use_scaled, chunk,
+                                     stagnation_window, int(stream_every),
+                                     a, b, aux, s),
+        to_portable=lambda s: s,
+        path=None, fingerprint="", cap=problem.iteration_cap,
+        keep_checkpoint=False,
+        watchdog=watchdog, on_chunk=on_chunk, deadline=deadline,
+    )
+    w = state.w * aux if use_scaled else state.w
+    return PCGResult(
+        w=w, iterations=state.k, diff=state.diff, residual_dot=state.zr,
+        flag=_deadline_flag(state, deadline),
     )

@@ -59,6 +59,13 @@ FLAG_CONVERGED = 1   # ‖Δw‖ < δ
 FLAG_BREAKDOWN = 2   # |（Ap, p)| below the degenerate-direction guard
 FLAG_NONFINITE = 3   # NaN/Inf reached the residual or update norm
 FLAG_STAGNATED = 4   # no best-‖Δw‖ improvement for a full stagnation window
+# Host-stamped only, never set inside the fused loop: the chunked drivers
+# (solvers.checkpoint / solvers.resilient) stamp it on the RESULT when a
+# per-request deadline expired at a chunk boundary before convergence —
+# the partial-result-with-flag contract of the solve service
+# (poisson_tpu.serve). The persisted PCGState never carries it, so a
+# deadline-stopped solve resumes cleanly with a larger budget.
+FLAG_DEADLINE = 5    # deadline expired mid-solve; w is the partial iterate
 
 FLAG_NAMES = {
     FLAG_NONE: "running",
@@ -66,6 +73,7 @@ FLAG_NAMES = {
     FLAG_BREAKDOWN: "breakdown",
     FLAG_NONFINITE: "nonfinite",
     FLAG_STAGNATED: "stagnated",
+    FLAG_DEADLINE: "deadline",
 }
 
 
@@ -127,6 +135,12 @@ class PCGResult(NamedTuple):
     # Batched solves only: scalar max over the member iteration vector
     # (None on scalar solves, an empty pytree node under jit).
     max_iterations: object = None
+    # Batched solves only: per-member origin identities (a tuple aligned
+    # with the leading batch axis, padding members already sliced off).
+    # Defaults to (0, 1, …, B−1); the solve service passes request ids so
+    # a member re-enqueued into a different bucket keeps its identity.
+    # Host-side metadata (ints/strings, not traced arrays).
+    origin: object = None
 
 
 def iterations_scalar(iterations) -> int:

@@ -46,6 +46,7 @@ from poisson_tpu.solvers.checkpoint import (
 )
 from poisson_tpu.solvers.pcg import (
     FLAG_CONVERGED,
+    FLAG_DEADLINE,
     FLAG_NAMES,
     FLAG_NONE,
     FLAG_NONFINITE,
@@ -144,7 +145,8 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                         keep_checkpoint: bool = False,
                         stream_every: int = 0,
                         watchdog=None,
-                        on_chunk=None) -> PCGResult:
+                        on_chunk=None,
+                        deadline=None) -> PCGResult:
     """Single-device solve that survives NaN blow-ups, Krylov breakdowns
     and stagnation by restarting from the last good iterate, escalating
     precision when a restart alone does not help.
@@ -155,7 +157,12 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
     checkpoints every ``chunk`` iterations (and resumes from them, even
     ones written at an escalated precision by an interrupted earlier run).
     ``watchdog``/``on_chunk`` are the chunk-boundary hooks documented on
-    ``solvers.checkpoint.run_chunked``.
+    ``solvers.checkpoint.run_chunked``. ``deadline`` (duck-typed:
+    ``expired() -> bool``) bounds the whole recovery effort: once it
+    expires at a chunk boundary, no further chunk or restart is started
+    and the partial iterate returns with ``flag == FLAG_DEADLINE`` — a
+    deadline never turns into a DivergenceError, and recovery never runs
+    on borrowed time.
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -196,10 +203,19 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
             "residual_dot": float(jnp.max(state.zr)),
         }
 
+    deadline_hit = False
     if watchdog is not None:
         watchdog.start()
     try:
         while True:
+            if deadline is not None and deadline.expired():
+                # Checked before a chunk OR a recovery starts: recovery on
+                # borrowed time would just blow the deadline further.
+                deadline_hit = True
+                obs.inc("resilient.deadline_stops")
+                obs.event("resilient.deadline_stop", iteration=int(state.k),
+                          restarts=restarts, chunks=chunks_done)
+                break
             state = _run_chunk(problem, use_scaled, chunk,
                                policy.stagnation_window, int(stream_every),
                                a, b, aux, state)
@@ -301,8 +317,13 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
     # DivergenceError. Counters (resilient.*) record the same facts
     # process-wide for the metrics snapshot.
     w = state.w * aux if use_scaled else state.w
+    flag_out = state.flag
+    if deadline_hit and int(state.flag) != FLAG_CONVERGED:
+        # Host-stamped, result-only: the persisted state keeps its honest
+        # in-loop verdict so a resume gets a clean slate.
+        flag_out = jnp.asarray(FLAG_DEADLINE, jnp.int32)
     return PCGResult(
         w=w, iterations=state.k, diff=state.diff, residual_dot=state.zr,
-        flag=state.flag,
+        flag=flag_out,
         restarts=restarts, recovery_history=tuple(history),
     )

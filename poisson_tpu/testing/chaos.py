@@ -1,0 +1,596 @@
+"""Chaos campaign: named, seeded, deterministic fault scenarios.
+
+``python -m poisson_tpu chaos --all --seed 0`` drives every scenario on
+CPU and exits 0 iff each one upheld its checks — first among them the
+service's no-lost-request invariant, asserted from the emitted
+``serve.*`` metrics snapshot:
+
+    admitted − (completed + typed-error + shed) == 0
+
+The campaign composes PR 1's solver-level fault injectors
+(``testing.faults``: NaN-at-k, preemption, checkpoint corruption, stall)
+with the service-level faults this PR adds (slow-worker, queue-burst,
+repeated-poison-request) into scenarios that each exercise one named
+survival property end to end:
+
+==========================  ============================================
+scenario                    property under test
+==========================  ============================================
+overload-shed               bounded admission: burst beyond capacity →
+                            typed ``queue_full`` sheds, never growth
+breaker-trip                consecutive cohort failures trip the
+                            breaker; cooldown → half-open probe → close
+deadline-mid-chunk          deadline expiry mid-solve → partial result
+                            flagged ``deadline``; expiry in queue → shed
+poison-requeue              a batch-killing member is isolated on retry;
+                            batchmates survive, the poison gets a typed
+                            transient error
+slow-worker                 a stalling worker burns queued deadlines:
+                            late requests shed instead of hanging
+queue-burst-degradation     the graceful-degradation ladder engages
+                            step by step as the queue drains
+divergence-escalate         a repeatedly-NaN-poisoned request escalates
+                            through the resilient driver and converges
+preempt-typed-error         an unexpected mid-chunk exception still
+                            yields exactly one typed outcome
+corrupt-checkpoint-resume   preempt + bit-flip the newest checkpoint →
+                            resume falls back a generation, bit-exact
+stall-watchdog              a wedged chunk trips the watchdog while a
+                            generous deadline stays out of the way
+==========================  ============================================
+
+Every scenario resets the metrics registry, runs against a
+:class:`VirtualClock` where timing matters (deadlines, backoff,
+breaker cooldowns — no wall-clock flake), seeds every RNG from the
+campaign seed, and returns a JSON-ready report embedding its ``serve.*``
+counter snapshot. Same seed → same outcomes, run to run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+import time
+import warnings
+
+import numpy as np
+
+
+class VirtualClock:
+    """A monotonic clock that only moves when told to: ``sleep``/
+    ``advance`` are the only sources of time. Injected as the service's
+    ``clock``/``sleep`` pair, it makes deadlines, backoff, and breaker
+    cooldowns deterministic — a chaos campaign must be a regression
+    suite, not a flake generator."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    now = __call__
+
+    def sleep(self, seconds: float) -> None:
+        self._now += max(0.0, float(seconds))
+
+    def advance(self, seconds: float) -> None:
+        self._now += float(seconds)
+
+
+_SCENARIOS: dict = {}
+
+
+def scenario(name: str):
+    def register(fn):
+        _SCENARIOS[name] = fn
+        return fn
+
+    return register
+
+
+def scenario_names() -> list:
+    return list(_SCENARIOS)
+
+
+def _problem():
+    from poisson_tpu.config import Problem
+
+    # 40×40 converges in 50 iterations — big enough for chunk boundaries
+    # and recovery to mean something, small enough that the whole
+    # campaign runs in seconds on CPU.
+    return Problem(M=40, N=40)
+
+
+def _quiet_degradation():
+    """Degradation disabled (thresholds unreachable) for scenarios that
+    are not about the ladder."""
+    from poisson_tpu.serve import DegradationPolicy
+
+    return DegradationPolicy(shrink_padding_at=9.0, cap_iterations_at=9.0,
+                             downshift_precision_at=9.0)
+
+
+def _reset_registries() -> None:
+    from poisson_tpu.obs import metrics
+    from poisson_tpu.solvers.batched import reset_bucket_cache
+
+    metrics.reset()
+    reset_bucket_cache()
+
+
+def _finish(name: str, seed: int, checks: dict, detail: dict) -> dict:
+    """Close a scenario: snapshot the metrics registry, assert the
+    no-lost-request invariant FROM THE SNAPSHOT (the emitted counters are
+    the record of truth, not the service's in-memory ledger), and bundle
+    the report."""
+    from poisson_tpu.obs import metrics
+
+    snap = metrics.snapshot()
+    counters = snap["counters"]
+    admitted = counters.get("serve.admitted", 0)
+    terminated = (counters.get("serve.completed", 0)
+                  + counters.get("serve.errors", 0)
+                  + counters.get("serve.shed", 0))
+    checks = dict(checks)
+    checks["no_lost_requests"] = (admitted - terminated) == 0
+    serve_counters = {k: v for k, v in sorted(counters.items())
+                      if k.startswith(("serve.", "resilient.",
+                                       "checkpoint.", "watchdog."))}
+    return {
+        "scenario": name,
+        "seed": seed,
+        "ok": all(checks.values()),
+        "checks": checks,
+        "invariant": {"admitted": admitted, "terminated": terminated,
+                      "lost": admitted - terminated},
+        "serve_counters": serve_counters,
+        "detail": detail,
+        "metrics_snapshot": snap,
+    }
+
+
+def _counter(name: str) -> float:
+    from poisson_tpu.obs import metrics
+
+    return metrics.get(name)
+
+
+# -- scenarios ----------------------------------------------------------
+
+
+@scenario("overload-shed")
+def _overload_shed(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        OUTCOME_SHED,
+        ServicePolicy,
+        SHED_QUEUE_FULL,
+        SolveRequest,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(capacity=6, max_batch=4,
+                      degradation=_quiet_degradation()),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    rng = random.Random(seed)
+    p = _problem()
+    admission_sheds = 0
+    for i in range(14):                       # burst: 14 into capacity 6
+        out = svc.submit(SolveRequest(request_id=i, problem=p,
+                                      rhs_gate=1.0 + rng.random()))
+        if out is not None:
+            admission_sheds += 1
+            assert out.kind == OUTCOME_SHED
+            assert out.shed_reason == SHED_QUEUE_FULL
+    outs = svc.drain()
+    return _finish("overload-shed", seed, {
+        "burst_exceeded_capacity": admission_sheds == 8,
+        "queue_full_sheds_counted": _counter("serve.shed.queue_full") == 8,
+        "admitted_work_completed": all(o.converged for o in outs),
+        "completed_matches_capacity": _counter("serve.completed") == 6,
+    }, {"admission_sheds": admission_sheds,
+        "drained": len(outs)})
+
+
+@scenario("breaker-trip")
+def _breaker_trip(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        BreakerPolicy,
+        CLOSED,
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+        TransientDispatchError,
+    )
+
+    vc = VirtualClock()
+    outage = {"on": True}
+
+    def fault(requests, attempts):
+        if outage["on"]:
+            raise TransientDispatchError("injected cohort outage")
+
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16,
+            retry=RetryPolicy(max_attempts=1),
+            breaker=BreakerPolicy(failure_threshold=3,
+                                  cooldown_seconds=10.0),
+            degradation=_quiet_degradation(),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed, dispatch_fault=fault,
+    )
+    p = _problem()
+    for i in range(3):                 # three consecutive typed failures
+        svc.submit(SolveRequest(request_id=i, problem=p))
+        svc.drain()
+    tripped = _counter("serve.breaker.trips") >= 1
+    svc.submit(SolveRequest(request_id=3, problem=p))
+    svc.submit(SolveRequest(request_id=4, problem=p))
+    shed_outs = svc.drain()            # breaker open: shed, no dispatch
+    outage["on"] = False
+    vc.advance(10.5)                   # cooldown passes → half-open
+    svc.submit(SolveRequest(request_id=5, problem=p))
+    probe_outs = svc.drain()           # probe succeeds → closed
+    svc.submit(SolveRequest(request_id=6, problem=p))
+    after_outs = svc.drain()
+    return _finish("breaker-trip", seed, {
+        "breaker_tripped": tripped,
+        "open_breaker_sheds": all(o.shed_reason == "breaker_open"
+                                  for o in shed_outs) and len(shed_outs) == 2,
+        "half_opened": _counter("serve.breaker.half_opens") >= 1,
+        "probe_closed_breaker": _counter("serve.breaker.closes") >= 1
+        and probe_outs[0].converged,
+        "healthy_after_close": after_outs[0].converged
+        and svc.stats()["breakers"]["40x40:auto:xla"] == CLOSED,
+    }, {"errors_during_outage": _counter("serve.errors.transient")})
+
+
+@scenario("deadline-mid-chunk")
+def _deadline_mid_chunk(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        OUTCOME_RESULT,
+        OUTCOME_SHED,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(degradation=_quiet_degradation()),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    p = _problem()
+
+    def tick(state, chunks_done):      # each chunk costs 0.4 virtual s
+        vc.advance(0.4)
+        return None
+
+    svc.submit(SolveRequest(request_id="deadlined", problem=p,
+                            deadline_seconds=1.0, chunk=5, on_chunk=tick))
+    svc.submit(SolveRequest(request_id="starved", problem=p,
+                            deadline_seconds=0.5))
+    outs = {o.request_id: o for o in svc.drain()}
+    partial = outs["deadlined"]
+    starved = outs["starved"]
+    return _finish("deadline-mid-chunk", seed, {
+        "partial_result_with_flag": partial.kind == OUTCOME_RESULT
+        and partial.flag == "deadline" and partial.partial
+        and not partial.converged,
+        "stopped_mid_solve": 0 < partial.iterations < 50,
+        "mid_solve_expiry_counted":
+            _counter("serve.deadline.expired_mid_solve") == 1,
+        "queued_expiry_shed": starved.kind == OUTCOME_SHED
+        and starved.shed_reason == "deadline_expired",
+    }, {"partial_iterations": partial.iterations})
+
+
+@scenario("poison-requeue")
+def _poison_requeue(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        OUTCOME_ERROR,
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import poison_batch_fault
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+            degradation=_quiet_degradation(),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=poison_batch_fault({"poison"}),
+    )
+    p = _problem()
+    svc.submit(SolveRequest(request_id="poison", problem=p))
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=f"innocent-{i}", problem=p,
+                                rhs_gate=1.0 + i / 10))
+    outs = {o.request_id: o for o in svc.drain()}
+    poison = outs["poison"]
+    innocents = [outs[f"innocent-{i}"] for i in range(3)]
+    return _finish("poison-requeue", seed, {
+        "poison_got_typed_error": poison.kind == OUTCOME_ERROR
+        and poison.error_type == "transient" and poison.attempts == 3,
+        "batchmates_survived": all(o.converged for o in innocents),
+        "requeues_isolated": _counter("serve.requeued.isolated") >= 3,
+        "retries_backed_off": _counter("serve.retries") >= 4
+        and _counter("serve.backoff_seconds") > 0,
+    }, {"poison_attempts": poison.attempts,
+        "innocent_attempts": [o.attempts for o in innocents]})
+
+
+@scenario("slow-worker")
+def _slow_worker(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import slow_worker_fault
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(capacity=16, degradation=_quiet_degradation()),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=slow_worker_fault(2.0, vc.sleep),
+    )
+    p = _problem()
+    for i in range(5):
+        svc.submit(SolveRequest(request_id=i, problem=p,
+                                deadline_seconds=3.0))
+    outs = {o.request_id: o for o in svc.drain()}
+    kinds = [outs[i].kind for i in range(5)]
+    return _finish("slow-worker", seed, {
+        "first_request_beat_its_deadline": outs[0].converged,
+        "in_flight_request_went_partial": outs[1].kind == "result"
+        and outs[1].flag == "deadline",
+        "starved_requests_shed": kinds[2:] == ["shed"] * 3
+        and _counter("serve.shed.deadline_expired") == 3,
+        "latency_reflects_stall":
+            svc.stats()["latency_seconds"]["p99"] >= 2.0,
+    }, {"kinds": kinds,
+        "p99": svc.stats()["latency_seconds"]["p99"]})
+
+
+@scenario("queue-burst-degradation")
+def _queue_burst_degradation(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        DegradationPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            capacity=12, max_batch=4,
+            degradation=DegradationPolicy(
+                shrink_padding_at=0.5, cap_iterations_at=0.75,
+                degraded_iteration_cap=10, downshift_precision_at=0.9,
+            ),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    p = _problem()
+    for i in range(11):                # burst to 11/12 of capacity
+        svc.submit(SolveRequest(request_id=i, problem=p))
+    outs = svc.drain()
+    partials = [o for o in outs if o.partial]
+    converged = [o for o in outs if o.converged]
+    return _finish("queue-burst-degradation", seed, {
+        "padding_shrunk_under_load": _counter("serve.degraded.padding") >= 2,
+        "iterations_capped_under_load":
+            _counter("serve.degraded.iteration_cap") >= 1,
+        "precision_downshifted_at_peak":
+            _counter("serve.degraded.precision") >= 1,
+        "capped_dispatches_went_partial": len(partials) == 4
+        and all(o.flag == "cap_hit" and o.iterations == 10
+                for o in partials),
+        "load_drained_back_to_full_service": len(converged) == 7,
+    }, {"partials": len(partials), "converged": len(converged)})
+
+
+@scenario("divergence-escalate")
+def _divergence_escalate(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import nan_per_solve_hook
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+            degradation=_quiet_degradation(),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    p = _problem()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)  # restart notices
+        svc.submit(SolveRequest(request_id="poisoned", problem=p, chunk=5,
+                                on_chunk=nan_per_solve_hook(10)))
+        (out,) = svc.drain()
+    return _finish("divergence-escalate", seed, {
+        "converged_after_escalation": out.converged and out.attempts == 2,
+        "escalated_via_resilient": _counter("serve.escalations") == 1
+        and out.restarts >= 1,
+        "in_solve_recovery_counted": _counter("resilient.restarts") >= 1,
+        # The restart discards the poisoned Krylov history, so the count
+        # may differ from the clean 50 — it must still be a real solve.
+        "full_convergence_reached": out.iterations >= 40,
+    }, {"attempts": out.attempts, "restarts": out.restarts,
+        "iterations": out.iterations})
+
+
+@scenario("preempt-typed-error")
+def _preempt_typed_error(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        OUTCOME_ERROR,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import FaultPlan, chunk_hook
+
+    vc = VirtualClock()
+    svc = SolveService(ServicePolicy(degradation=_quiet_degradation()),
+                       clock=vc, sleep=vc.sleep, seed=seed)
+    p = _problem()
+    svc.submit(SolveRequest(
+        request_id="preempted", problem=p, chunk=5,
+        on_chunk=chunk_hook(FaultPlan(preempt_after_chunks=2)),
+    ))
+    (out,) = svc.drain()
+    return _finish("preempt-typed-error", seed, {
+        "typed_internal_error": out.kind == OUTCOME_ERROR
+        and out.error_type == "internal"
+        and "PreemptionInjected" in out.message,
+        "error_counted": _counter("serve.errors.internal") == 1,
+    }, {"message": out.message[:120]})
+
+
+@scenario("corrupt-checkpoint-resume")
+def _corrupt_checkpoint_resume(seed: int) -> dict:
+    from poisson_tpu.solvers.checkpoint import (
+        pcg_solve_checkpointed,
+        pcg_solve_chunked,
+    )
+    from poisson_tpu.testing.faults import (
+        FaultPlan,
+        PreemptionInjected,
+        chunk_hook,
+        corrupt_file,
+    )
+
+    p = _problem()
+    golden = pcg_solve_chunked(p, chunk=10)
+    with tempfile.TemporaryDirectory(prefix="poisson-chaos-") as td:
+        path = os.path.join(td, "ck.npz")
+        try:
+            pcg_solve_checkpointed(
+                p, path, chunk=10, keep_last=2,
+                on_chunk=chunk_hook(FaultPlan(preempt_after_chunks=3)),
+            )
+            preempted = False
+        except PreemptionInjected:
+            preempted = True
+        corrupt_file(path, "flip")      # bit-rot the newest generation
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            resumed = pcg_solve_checkpointed(p, path, chunk=10,
+                                             keep_last=2)
+    return _finish("corrupt-checkpoint-resume", seed, {
+        "preemption_fired": preempted,
+        # The flipped byte may land in the payload (CRC catches it) or in
+        # the npz structure itself (the loader reports it unreadable) —
+        # either way the damage must be DETECTED, never resumed.
+        "corruption_detected": _counter("checkpoint.crc_failures")
+        + _counter("checkpoint.corrupt") >= 1,
+        "older_generation_resumed":
+            _counter("checkpoint.generation_fallbacks") >= 1,
+        "bit_exact_after_recovery":
+            int(resumed.iterations) == int(golden.iterations)
+            and bool(np.array_equal(np.asarray(resumed.w),
+                                    np.asarray(golden.w))),
+    }, {"iterations": int(resumed.iterations)})
+
+
+@scenario("stall-watchdog")
+def _stall_watchdog(seed: int) -> dict:
+    from poisson_tpu.parallel.watchdog import Watchdog
+    from poisson_tpu.serve import Deadline
+    from poisson_tpu.solvers.checkpoint import pcg_solve_chunked
+
+    p = _problem()
+    fired = []
+    wd = Watchdog(timeout=0.15, poll_interval=0.03,
+                  on_timeout=fired.append)   # record, don't interrupt
+    stalled = {"done": False}
+
+    def stall_once(state, chunks_done):
+        if not stalled["done"]:
+            stalled["done"] = True
+            time.sleep(0.5)                  # a genuinely wedged chunk
+        return None
+
+    res = pcg_solve_chunked(p, chunk=10, watchdog=wd, on_chunk=stall_once,
+                            deadline=Deadline(3600.0))
+    from poisson_tpu.solvers.pcg import FLAG_CONVERGED
+
+    return _finish("stall-watchdog", seed, {
+        "watchdog_fired_on_stall": wd.fired and len(fired) == 1
+        and _counter("watchdog.stalls") >= 1,
+        "beats_recorded": _counter("watchdog.beats") >= 4,
+        # Deadline-vs-watchdog: the stall is a liveness event, not a
+        # budget event — the generous deadline must NOT flag the result.
+        "deadline_stayed_quiet": int(res.flag) == FLAG_CONVERGED
+        and int(res.iterations) == 50,
+    }, {"stall_diag_beats": fired[0]["beats"] if fired else None})
+
+
+# -- campaign runner ----------------------------------------------------
+
+
+def run_scenario(name: str, seed: int = 0) -> dict:
+    """Run one scenario from a clean metrics registry; returns its
+    JSON-ready report (``report['ok']`` is the verdict)."""
+    if name not in _SCENARIOS:
+        raise KeyError(
+            f"unknown chaos scenario {name!r}; known: "
+            f"{', '.join(sorted(_SCENARIOS))}"
+        )
+    _reset_registries()
+    return _SCENARIOS[name](seed)
+
+
+def run_campaign(names=None, seed: int = 0, out_dir=None) -> dict:
+    """Run the named scenarios (default: all, in registration order).
+    ``out_dir`` keeps one metrics snapshot (JSON + Prometheus text) per
+    scenario plus the campaign report. Deterministic under a fixed seed.
+    """
+    from poisson_tpu.obs import export
+
+    names = list(names) if names else scenario_names()
+    reports = []
+    for name in names:
+        report = run_scenario(name, seed=seed)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            snap = report["metrics_snapshot"]
+            with open(os.path.join(out_dir,
+                                   f"metrics-{name}.json"), "w") as f:
+                json.dump(snap, f, sort_keys=True, indent=1, default=str)
+            export.write_textfile(
+                os.path.join(out_dir, f"metrics-{name}.prom"), snap)
+        reports.append(report)
+    campaign = {
+        "schema": "poisson_tpu.chaos/1",
+        "seed": seed,
+        "scenarios": [{k: v for k, v in r.items()
+                       if k != "metrics_snapshot"} for r in reports],
+        "ok": all(r["ok"] for r in reports),
+    }
+    if out_dir:
+        with open(os.path.join(out_dir, "campaign.json"), "w") as f:
+            json.dump(campaign, f, sort_keys=True, indent=1, default=str)
+    return campaign

@@ -118,3 +118,72 @@ def chunk_hook(plan: FaultPlan):
         return None
 
     return hook
+
+
+def nan_per_solve_hook(at_iteration: int, buffer: str = "r"):
+    """Like ``chunk_hook``'s NaN injection, but re-armed for every new
+    solve run: a *repeated-poison* request that blows up once per dispatch
+    attempt (the chaos campaign's divergence-escalation scenario — the
+    plain chunked dispatch dies, and the escalated resilient dispatch must
+    recover from the same injection rather than ride a spent hook). A new
+    run is detected by the ``chunks_done`` counter restarting."""
+    state_ = {"armed": True, "last_chunks": 0}
+
+    def hook(state, chunks_done: int):
+        if chunks_done <= state_["last_chunks"]:
+            state_["armed"] = True
+        state_["last_chunks"] = chunks_done
+        if state_["armed"] and int(state.k) >= at_iteration:
+            state_["armed"] = False
+            return inject_nan(state, buffer)
+        return None
+
+    return hook
+
+
+# -- service-level faults (poisson_tpu.serve dispatch seam) -------------
+
+
+def poison_batch_fault(poison_ids):
+    """A *repeated-poison-request* injector for the solve service's
+    ``dispatch_fault`` seam: any dispatch whose batch contains one of
+    ``poison_ids`` dies whole with :class:`~poisson_tpu.serve.types.\
+TransientDispatchError` — the model of a member whose payload crashes the
+    device program and takes its batchmates with it. The service's
+    requeue isolation (mutual taint) must keep the poison from re-killing
+    the same batchmates on retry."""
+    poison = set(poison_ids)
+
+    def fault(requests, attempts):
+        hit = [r.request_id for r in requests if r.request_id in poison]
+        if hit:
+            from poisson_tpu.serve.types import TransientDispatchError
+
+            raise TransientDispatchError(
+                f"injected device fault (poison member(s) {hit} in a "
+                f"batch of {len(requests)})"
+            )
+
+    return fault
+
+
+def slow_worker_fault(seconds: float, sleep):
+    """A *slow-worker* injector: every dispatch stalls for ``seconds`` on
+    the service's (virtual or real) clock before the solver runs —
+    queued deadlines burn down behind it, which is exactly the overload
+    pathology deadline-shedding exists for."""
+
+    def fault(requests, attempts):
+        sleep(seconds)
+
+    return fault
+
+
+def compose_faults(*faults):
+    """Run several dispatch-seam injectors in order (first raise wins)."""
+
+    def fault(requests, attempts):
+        for f in faults:
+            f(requests, attempts)
+
+    return fault

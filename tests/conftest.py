@@ -63,6 +63,13 @@ def pytest_configure(config):
         "(cost model vs cost_analysis, Prometheus exposition, regress.py "
         "verdicts; CPU-fast; runs in tier-1, selectable with -m perf_obs)",
     )
+    config.addinivalue_line(
+        "markers",
+        "serve: solve-service & chaos-campaign suite (admission/"
+        "deadline/retry/breaker/degradation lifecycle, seeded "
+        "deterministic chaos scenarios, the no-lost-request invariant; "
+        "CPU-fast; runs in tier-1, selectable with -m serve)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
