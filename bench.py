@@ -19,6 +19,15 @@ load — batch-killing poison requests exercising retry isolation:
 with p50/p95, shed rate, and throughput in the detail, plus the
 ``fault_load`` cohort discriminator the regression sentinel keys on.
 
+Open-loop service mode (``--serve R --arrival-rate L``) generates a
+seeded Poisson arrival schedule at L requests/sec and measures sustained
+throughput twice over the same schedule — batch-drain vs the
+continuous-batching lane engine (``ServicePolicy.scheduling``):
+    {"metric": "serve.sustained_solves_per_sec", "value": S, ...}
+with both engines' p50/p99 and the drain arm's sustained rate in the
+detail (``continuous_beats_drain`` is the at-equal-p99 verdict), cohorted
+by ``arrival_rate`` + ``fault_load`` so rates are never cross-judged.
+
 Both modes honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the persistent JAX
 compilation cache; hits/misses are counted in the metrics snapshot).
 
@@ -373,6 +382,194 @@ def _batched_bench(problem, batch: int, devices, platform: str,
     return 0
 
 
+def _warm_serve_buckets(problem, dtype, max_batch: int, requests: int,
+                        refill_chunk=None, exact_sizes=()) -> list:
+    """Compile every bucket executable a serve-mode schedule can touch.
+
+    The old warm-up ran one full campaign, which only reliably warms the
+    FIRST bucket shape the batch former happens to produce — a timed run
+    whose formation drifts (real clocks, backoff jitter) then absorbs a
+    compile spike into its p99. Warm the whole bucket ladder up to the
+    largest dispatchable batch instead: a zero rhs_gate converges
+    degenerately at iteration 1 (the padding-member trick,
+    ``solvers.batched``), so each warm-up costs one compile plus one
+    masked iteration, and gates are traced values — the warmed
+    executable is exactly the one real gates reuse. ``refill_chunk``
+    additionally warms the continuous engine's lane stepping program
+    (``solvers.lanes``) for each bucket. ``exact_sizes`` warms
+    non-power-of-two bucket shapes on top of the ladder — the
+    degradation ladder's padding-shrink step dispatches exact-size
+    batches, which the power-of-two ladder alone would leave cold.
+    """
+    from poisson_tpu.solvers.batched import bucket_size, solve_batched
+    from poisson_tpu.utils.timing import fence
+
+    top = bucket_size(min(max_batch, max(1, requests)))
+    ladder, b = [], 1
+    while b <= top:
+        ladder.append(b)
+        b *= 2
+    ladder = sorted(set(ladder) | {int(s) for s in exact_sizes
+                                   if 1 <= int(s) <= max_batch})
+    for b in ladder:
+        fence(solve_batched(problem, rhs_gates=[0.0] * b, dtype=dtype,
+                            bucket=b).iterations)
+        if refill_chunk is not None:
+            from poisson_tpu.solvers.lanes import LaneBatch
+
+            # One splice → step → retire cycle per bucket warms the lane
+            # stepping program AND the traced-index splice/retire helpers
+            # (each is compiled per bucket width).
+            lanes = LaneBatch(problem, b, dtype=dtype, chunk=refill_chunk)
+            lanes.splice("warmup", 0.0)
+            lanes.step()
+            lanes.retire(0)
+    return ladder
+
+
+def _serve_openloop_bench(problem, requests: int, rate: float, devices,
+                          platform: str, downgraded: bool = False) -> int:
+    """Open-loop service mode: Poisson arrivals at ``rate`` requests/sec
+    (``--serve R --arrival-rate L``), measured twice over the SAME seeded
+    schedule — once under the PR 5 batch-drain engine, once under the
+    continuous-batching lane engine — and reported as sustained
+    solves/sec with the latency percentiles of each. Open loop means
+    arrivals do not wait for the service: the generator submits on the
+    wall clock and the service joins them to in-flight work (continuous)
+    or queues them behind the running dispatch (drain). That is the
+    millions-of-users load shape, and the A/B inside one record is what
+    makes "continuous refill beats batch-drain at equal p99" a
+    regress.py-cohortable claim rather than an assertion.
+    """
+    import random
+
+    from poisson_tpu import obs
+    from poisson_tpu.serve import (
+        DegradationPolicy,
+        RetryPolicy,
+        SCHED_CONTINUOUS,
+        SCHED_DRAIN,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+
+    max_batch = 4
+    refill_chunk = 50
+    # Degradation quiet + ample capacity: this record compares the two
+    # SCHEDULING engines, so the policy ladder must not fire differently
+    # between the arms.
+    quiet = DegradationPolicy(shrink_padding_at=9.0,
+                              cap_iterations_at=9.0,
+                              downshift_precision_at=9.0)
+    rng = random.Random(0)
+    schedule, t = [], 0.0
+    for i in range(requests):
+        t += rng.expovariate(rate)
+        schedule.append((t, i, 1.0 + rng.random()))
+
+    def make_policy(mode):
+        return ServicePolicy(
+            capacity=max(4 * requests, 16), max_batch=max_batch,
+            scheduling=mode, refill_chunk=refill_chunk,
+            degradation=quiet,
+            retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
+                              backoff_cap=0.1),
+        )
+
+    def run(mode):
+        svc = SolveService(make_policy(mode), seed=0)
+        t0 = time.perf_counter()
+        i = 0
+        while True:
+            now = time.perf_counter() - t0
+            while i < len(schedule) and schedule[i][0] <= now:
+                _, rid, gate = schedule[i]
+                svc.submit(SolveRequest(request_id=rid, problem=problem,
+                                        rhs_gate=gate, dtype="float32"))
+                i += 1
+            if svc.pump():
+                continue
+            if i >= len(schedule):
+                break
+            wait = schedule[i][0] - (time.perf_counter() - t0)
+            if wait > 0:          # idle until the next arrival is due
+                time.sleep(min(wait, 0.005))
+        svc.drain()               # publish the serve.* gauges
+        makespan = time.perf_counter() - t0
+        return svc.stats(), makespan
+
+    with obs.span("bench.serve_warmup", fence=False, requests=requests):
+        t0 = time.time()
+        warmed = _warm_serve_buckets(problem, "float32", max_batch,
+                                     requests, refill_chunk=refill_chunk)
+        warm_seconds = time.time() - t0
+    obs.inc("time.compile_seconds", warm_seconds)
+
+    with obs.span("bench.serve_openloop", fence=False, mode="drain",
+                  requests=requests):
+        drain_stats, drain_span = run(SCHED_DRAIN)
+    with obs.span("bench.serve_openloop", fence=False, mode="continuous",
+                  requests=requests):
+        cont_stats, cont_span = run(SCHED_CONTINUOUS)
+
+    sustained = cont_stats["completed"] / cont_span if cont_span else 0.0
+    drain_sustained = (drain_stats["completed"] / drain_span
+                       if drain_span else 0.0)
+    p99 = cont_stats["latency_seconds"]["p99"]
+    drain_p99 = drain_stats["latency_seconds"]["p99"]
+    from poisson_tpu.obs import metrics as obs_metrics
+
+    record = {
+        "metric": "serve.sustained_solves_per_sec",
+        "value": round(sustained, 3),
+        "unit": "solves/sec",
+        "detail": {
+            "grid": [problem.M, problem.N],
+            "requests": requests,
+            "arrival_rate": rate,
+            "scheduling": "continuous",
+            "drain_solves_per_sec": round(drain_sustained, 3),
+            "p99_seconds": round(p99, 4),
+            "drain_p99_seconds": round(drain_p99, 4),
+            "p50_seconds": round(cont_stats["latency_seconds"]["p50"], 4),
+            "drain_p50_seconds": round(
+                drain_stats["latency_seconds"]["p50"], 4),
+            "completed": cont_stats["completed"],
+            "errors": cont_stats["errors"],
+            "shed": cont_stats["shed"],
+            "lost": cont_stats["lost"] + drain_stats["lost"],
+            "makespan_seconds": round(cont_span, 4),
+            "drain_makespan_seconds": round(drain_span, 4),
+            "refill_splices": obs_metrics.get("serve.refill.splices"),
+            "idle_lane_steps": obs_metrics.get(
+                "serve.refill.idle_lane_steps"),
+            "continuous_beats_drain": bool(
+                sustained >= drain_sustained and p99 <= drain_p99),
+            "warmed_buckets": warmed,
+            "warmup_seconds": round(warm_seconds, 2),
+            "dtype": "float32",
+            "backend": "xla_serve",
+            "devices": 1,
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "platform_fallback": downgraded,
+            # Cohort discriminators for benchmarks/regress.py: sustained
+            # throughput at one arrival rate is a different experiment
+            # from another rate or a faulted campaign.
+            "fault_load": "clean",
+        },
+    }
+    obs.gauge("serve.sustained_solves_per_sec", record["value"])
+    obs.gauge("serve.drain_solves_per_sec",
+              record["detail"]["drain_solves_per_sec"])
+    obs.event("bench.serve_openloop", **record["detail"],
+              sustained_solves_per_sec=record["value"])
+    obs.finalize()
+    print(json.dumps(record))
+    return 0 if record["detail"]["lost"] == 0 else 1
+
+
 def _serve_bench(problem, requests: int, devices, platform: str,
                  downgraded: bool = False) -> int:
     """Service mode: throughput and latency percentiles under fault load.
@@ -422,7 +619,23 @@ def _serve_bench(problem, requests: int, devices, platform: str,
 
     with obs.span("bench.serve_warmup", fence=False, requests=requests):
         t0 = time.time()
-        load(build())                 # compile + first full campaign
+        # Every ladder bucket the batch former can produce, THEN a full
+        # campaign: the campaign alone only warms the shapes its own
+        # (clock-dependent) batch formation happened to hit, and a
+        # timed run that drifts onto a cold bucket absorbs the compile
+        # spike into its p99. With capacity == requests the burst load
+        # engages the padding-shrink step (exact-size buckets), so warm
+        # the deterministic descending batch sequence the degraded
+        # formation produces on top of the power-of-two ladder.
+        exact, s = set(), requests
+        while s > 0 and (s / policy.capacity
+                         >= policy.degradation.shrink_padding_at):
+            b = min(s, policy.max_batch)
+            exact.add(b)
+            s -= b
+        _warm_serve_buckets(problem, "float32", policy.max_batch,
+                            requests, exact_sizes=exact)
+        load(build())                 # first full campaign
         first_run = time.time() - t0
     obs.inc("time.compile_seconds", first_run)
 
@@ -541,12 +754,30 @@ def main() -> int:
         try:
             serve_requests = int(argv[i + 1])
         except (IndexError, ValueError):
-            print("usage: python bench.py [--batch B | --serve R] [M N]",
-                  file=sys.stderr)
+            print("usage: python bench.py [--batch B | --serve R "
+                  "[--arrival-rate L]] [M N]", file=sys.stderr)
             return 2
         argv = argv[:i] + argv[i + 2:]
         if serve_requests < 1:
             print(f"--serve must be >= 1, got {serve_requests}",
+                  file=sys.stderr)
+            return 2
+    arrival_rate = None
+    if "--arrival-rate" in argv:
+        i = argv.index("--arrival-rate")
+        try:
+            arrival_rate = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python bench.py --serve R --arrival-rate "
+                  "LAMBDA [M N]", file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if serve_requests is None:
+            print("--arrival-rate is a --serve mode option",
+                  file=sys.stderr)
+            return 2
+        if arrival_rate <= 0:
+            print(f"--arrival-rate must be > 0, got {arrival_rate}",
                   file=sys.stderr)
             return 2
     if batch is not None and serve_requests is not None:
@@ -598,6 +829,10 @@ def main() -> int:
         return _batched_bench(problem, batch, devices, platform,
                               downgraded=downgraded)
     if serve_requests is not None:
+        if arrival_rate is not None:
+            return _serve_openloop_bench(problem, serve_requests,
+                                         arrival_rate, devices, platform,
+                                         downgraded=downgraded)
         return _serve_bench(problem, serve_requests, devices, platform,
                             downgraded=downgraded)
 

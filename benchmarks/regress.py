@@ -32,7 +32,11 @@ Service-mode records (``bench.py --serve``: ``serve.p99_latency``,
 that grew is the slowdown), and their injected fault mix
 (``detail.fault_load``) is part of the cohort key — a latency percentile
 measured under chaos faults is a different experiment from a clean run
-and is never judged against its baseline.
+and is never judged against its baseline. Open-loop records
+(``--serve R --arrival-rate L``: ``serve.sustained_solves_per_sec``,
+higher-is-better like MLUPS) additionally carry ``detail.arrival_rate``
+in the cohort key: sustained throughput at one offered load never
+judges another.
 
 Stdlib only, no jax import: like the forensics renderer, a post-session
 gate must never risk initializing a backend.
@@ -61,11 +65,14 @@ _FALLBACK_TAIL_MARKS = (
 )
 
 _METRICS = ("mlups", "batched_solves_per_sec",
-            "serve.p99_latency", "serve.shed_rate")
+            "serve.p99_latency", "serve.shed_rate",
+            "serve.sustained_solves_per_sec")
 
 # Service metrics regress UPWARD: a p99 latency or a shed rate that grew
 # is the slowdown, where MLUPS/solves-per-sec regress downward. The
 # alarm line flips sides accordingly (median + guard instead of − guard).
+# serve.sustained_solves_per_sec (the open-loop continuous-batching
+# throughput) is deliberately NOT here: like MLUPS, a drop is the alarm.
 _LOWER_IS_BETTER = {"serve.p99_latency", "serve.shed_rate"}
 
 
@@ -73,6 +80,7 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                backend=None, grid=None, dtype=None, devices=None,
                platform_fallback=False, failed=False,
                fault_load: Optional[str] = None,
+               arrival_rate: Optional[float] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -90,6 +98,10 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # against a clean baseline (a latency percentile under injected
         # slow-workers is a different experiment, not a regression).
         "fault_load": fault_load,
+        # Open-loop serve records (bench.py --serve --arrival-rate):
+        # sustained throughput and percentiles at one offered load are a
+        # different experiment from another rate — cohort key too.
+        "arrival_rate": arrival_rate,
         "failed": bool(failed),
         "note": note,
     }
@@ -115,6 +127,7 @@ def record_from_result(result: dict, source: str,
         devices=det.get("devices"),
         platform_fallback=fallback,
         fault_load=det.get("fault_load"),
+        arrival_rate=det.get("arrival_rate"),
     )
 
 
@@ -203,11 +216,14 @@ def load_session(path) -> list[dict]:
 def cohort_key(rec: dict):
     """Records are only ever compared inside this key: same metric, same
     grid, same dtype, same platform/backend/device-count — and, for
-    service-mode records, the same injected fault load (fault-load runs
-    are never judged against clean baselines)."""
+    service-mode records, the same injected fault load AND the same
+    open-loop arrival rate (fault-load runs are never judged against
+    clean baselines; throughput at one offered load is a different
+    experiment from another)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
-            rec.get("devices"), rec.get("fault_load"))
+            rec.get("devices"), rec.get("fault_load"),
+            rec.get("arrival_rate"))
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

@@ -20,7 +20,9 @@ from a unified-telemetry directory (``poisson_tpu.obs`` — what
 ``python -m poisson_tpu … --trace-dir DIR`` writes): phases and their
 durations, restarts/escalations, checkpoint activity, watchdog
 beats/stalls, stop verdicts, MLUPS, the streamed convergence curve
-summary, the performance-attribution gauges (compiled-program cost vs
+summary, the continuous-batching refill counters (``serve.refill.*``
+plus any open-loop batch-drain-vs-continuous A/B records), the
+performance-attribution gauges (compiled-program cost vs
 the analytic stencil model, achieved-vs-roofline fraction —
 ``poisson_tpu.obs.costs``), and the regression sentinel's verdict over
 the committed bench history (``benchmarks/regress.py``) — the
@@ -317,6 +319,39 @@ def telemetry_report(tdir: pathlib.Path) -> int:
             if match is False:
                 line += " — PER-MEMBER ITERATIONS MISMATCH"
             print(line)
+
+    # Continuous batching (serve.refill.*): the lane table's refill
+    # state machine, plus any open-loop A/B records
+    # (bench.py --serve --arrival-rate).
+    refill_counters = {name: val for name, val in counters.items()
+                       if name.startswith("serve.refill.")}
+    openloop = [e for e in events if e.get("kind") == "event"
+                and e.get("name") == "bench.serve_openloop"]
+    if refill_counters or openloop:
+        print("\n## Continuous batching\n")
+        if refill_counters:
+            print("| refill counter | value |")
+            print("|---|---|")
+            for name in sorted(refill_counters):
+                val = refill_counters[name]
+                shown = (f"{val:.4f}" if isinstance(val, float)
+                         else str(val))
+                print(f"| {name} | {shown} |")
+            splices = refill_counters.get("serve.refill.splices", 0)
+            idle = refill_counters.get("serve.refill.idle_lane_steps", 0)
+            print(f"\n{splices} splice(s) into running lane programs, "
+                  f"{idle} idle lane-step(s) paid for the open seats.")
+        for e in openloop:
+            grid = e.get("grid") or ["?", "?"]
+            verdict = ("continuous beat batch-drain at equal p99"
+                       if e.get("continuous_beats_drain")
+                       else "batch-drain held its own at this load "
+                            "(see the regime note in BENCH.md)")
+            print(f"- {grid[0]}x{grid[1]} @ {e.get('arrival_rate')}/s: "
+                  f"continuous {e.get('sustained_solves_per_sec')} sv/s "
+                  f"(p99 {e.get('p99_seconds')} s) vs drain "
+                  f"{e.get('drain_solves_per_sec')} sv/s (p99 "
+                  f"{e.get('drain_p99_seconds')} s) — {verdict}")
 
     # Incidents: everything that is not routine liveness.
     incidents = [e for e in events if e.get("kind") == "event" and e.get(

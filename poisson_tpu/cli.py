@@ -821,6 +821,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "else float32)")
     p.add_argument("--vary-rhs", action="store_true",
                    help="give each request a distinct RHS magnitude")
+    p.add_argument("--continuous", action="store_true",
+                   help="continuous-batching scheduling: a lane table "
+                        "steps the fused program chunk by chunk, "
+                        "retires converged lanes to their outcomes and "
+                        "splices queued RHS into the freed lanes of the "
+                        "same executable (default: batch-drain)")
+    p.add_argument("--refill-chunk", type=int, default=25,
+                   help="iterations per lane-table step in --continuous "
+                        "mode (default 25)")
     p.add_argument("--seed", type=int, default=0,
                    help="backoff-jitter / load RNG seed (default 0)")
     p.add_argument("--fault-poison", type=int, default=0, metavar="K",
@@ -862,6 +871,8 @@ def _main_serve(argv) -> int:
         OUTCOME_ERROR,
         OUTCOME_RESULT,
         OUTCOME_SHED,
+        SCHED_CONTINUOUS,
+        SCHED_DRAIN,
         ServicePolicy,
         SolveRequest,
         SolveService,
@@ -876,7 +887,10 @@ def _main_serve(argv) -> int:
         fault = poison_batch_fault(set(range(args.fault_poison)))
     svc = SolveService(
         ServicePolicy(capacity=args.capacity, max_batch=args.max_batch,
-                      default_chunk=args.chunk or 50),
+                      default_chunk=args.chunk or 50,
+                      scheduling=(SCHED_CONTINUOUS if args.continuous
+                                  else SCHED_DRAIN),
+                      refill_chunk=args.refill_chunk),
         seed=args.seed, dispatch_fault=fault,
     )
     rng = _random.Random(args.seed)
@@ -898,6 +912,7 @@ def _main_serve(argv) -> int:
                   if o.kind == OUTCOME_RESULT and o.partial)
     record = {
         "M": problem.M, "N": problem.N, "requests": args.requests,
+        "scheduling": svc.policy.scheduling,
         "wall_seconds": round(wall, 4),
         "throughput_rps": round(stats["completed"] / wall, 2) if wall
         else None,

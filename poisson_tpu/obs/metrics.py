@@ -49,7 +49,16 @@ Naming convention (dotted, low cardinality):
   ``serve.breaker.{trips,half_opens,closes}`` / the degradation ladder
   ``serve.degraded.{padding,iteration_cap,precision}``; plus the
   deadline stops the chunked drivers count
-  (``checkpoint.deadline_stops`` / ``resilient.deadline_stops``).
+  (``checkpoint.deadline_stops`` / ``resilient.deadline_stops``);
+- ``serve.refill.*`` — the continuous-batching lane table
+  (``serve.refill`` + ``solvers.lanes``, ``ServicePolicy.scheduling=
+  "continuous"``): ``serve.refill.splices`` (queued RHS spliced into
+  freed lanes of a running bucket executable) /
+  ``serve.refill.retired_lanes`` (lanes retired to a typed outcome at a
+  chunk boundary) / ``serve.refill.idle_lane_steps`` (Σ EMPTY lanes per
+  chunk step — the fused width paid for open seats) /
+  ``serve.refill.refill_denied_by_breaker`` (refill decisions refused
+  by an open cohort breaker).
 
 Gauge families (``obs.costs`` sets these; ``obs.export`` exposes both
 counters and numeric gauges in Prometheus text format):
@@ -66,7 +75,10 @@ counters and numeric gauges in Prometheus text format):
   ``serve.lost_requests`` / ``serve.p99_latency_seconds`` — service
   health, refreshed on every drain; ``serve.latency_seconds`` is a
   ``{"p50": …, "p95": …, "p99": …}`` dict that ``obs.export`` renders as
-  a Prometheus summary with quantile labels.
+  a Prometheus summary with quantile labels;
+- ``serve.refill.active_lanes`` (occupancy after the latest chunk step)
+  and ``serve.sustained_solves_per_sec`` / ``serve.drain_solves_per_sec``
+  (the open-loop A/B headline, ``bench.py --serve --arrival-rate``).
 """
 
 from __future__ import annotations

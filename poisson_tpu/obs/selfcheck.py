@@ -15,7 +15,10 @@ model (``obs.costs``, agreement within ±25%), a Prometheus exposition
 round trip (``obs.export`` render → parse, live ``/metrics`` endpoint),
 and the regression sentinel (``benchmarks/regress.py``) on a synthetic
 history that must classify a platform fallback as such and flag a 2×
-slowdown.
+slowdown. Steps 11–12 run LAST (each resets the metrics registry): the
+solve-service → chaos → exposition smoke, then the continuous-batching
+smoke — an open-loop refill drive, the refill-poison-splice race, and
+the ``serve.refill.*`` counters surviving exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -248,13 +251,65 @@ def run_selfcheck(out_dir: str) -> int:
         return _fail("exposition lost the serve latency summary "
                      f"(looked for {p99_key})")
 
+    # 12. Continuous batching, end to end (runs LAST, clean registry):
+    # an open-loop drive of the refill engine — a request is two chunks
+    # into a lane program when two more arrive and splice into the SAME
+    # running executable — then a refill-race chaos scenario, with the
+    # serve.refill.* counters surviving the exposition round trip.
+    from poisson_tpu.obs import metrics as obs_metrics
+    from poisson_tpu.serve import (
+        SCHED_CONTINUOUS,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.chaos import VirtualClock
+
+    obs_metrics.reset()
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(scheduling=SCHED_CONTINUOUS, max_batch=4,
+                      refill_chunk=10),
+        clock=vc, sleep=vc.sleep, seed=0,
+    )
+    svc.submit(SolveRequest(request_id=0, problem=problem))
+    svc.pump()
+    svc.pump()                     # request 0 is now mid-flight
+    for i in (1, 2):               # open-loop arrivals join it
+        svc.submit(SolveRequest(request_id=i, problem=problem,
+                                rhs_gate=1.0 + i / 10))
+    svc.drain()
+    serve_stats = svc.stats()
+    if serve_stats["lost"] != 0 or serve_stats["completed"] != 3:
+        return _fail(f"continuous engine lost requests: {serve_stats}")
+    splices = obs_metrics.get("serve.refill.splices")
+    retired = obs_metrics.get("serve.refill.retired_lanes")
+    if splices < 3 or retired < 3:
+        return _fail(f"refill counters missing the open-loop drive: "
+                     f"splices={splices}, retired={retired}")
+    refill_report = chaos.run_scenario("refill-poison-splice", seed=0)
+    if not refill_report["ok"]:
+        failed = [k for k, v in refill_report["checks"].items() if not v]
+        return _fail(f"chaos scenario refill-poison-splice failed: "
+                     f"{failed}")
+    if refill_report["invariant"]["lost"] != 0:
+        return _fail(f"refill chaos scenario lost requests: "
+                     f"{refill_report['invariant']}")
+    refill_parsed = export.parse_text(
+        export.render(refill_report["metrics_snapshot"]))
+    for prom_name in ("poisson_tpu_serve_refill_splices",
+                      "poisson_tpu_serve_refill_retired_lanes"):
+        if prom_name not in refill_parsed:
+            return _fail(f"exposition lost the {prom_name} counter")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
           f"{n_profile_files} profile files, {len(parsed)} exposition "
           f"metrics, sentinel ok, chaos overload-shed ok "
-          f"({report['invariant']['admitted']} admitted, 0 lost) "
-          f"({out_dir})")
+          f"({report['invariant']['admitted']} admitted, 0 lost), "
+          f"continuous batching ok ({int(splices)} splices, "
+          f"refill-poison-splice green) ({out_dir})")
     return 0
 
 

@@ -36,6 +36,8 @@ from poisson_tpu.serve.types import (
     DegradationPolicy,
     Outcome,
     RetryPolicy,
+    SCHED_CONTINUOUS,
+    SCHED_DRAIN,
     ServicePolicy,
     SHED_BREAKER_OPEN,
     SHED_DEADLINE_EXPIRED,
@@ -48,7 +50,8 @@ __all__ = [
     "BreakerPolicy", "CircuitBreaker", "CLOSED", "Deadline",
     "DegradationPolicy", "ERROR_DIVERGENCE", "ERROR_INTERNAL",
     "ERROR_TRANSIENT", "HALF_OPEN", "OPEN", "Outcome", "OUTCOME_ERROR",
-    "OUTCOME_RESULT", "OUTCOME_SHED", "RetryPolicy", "ServicePolicy",
+    "OUTCOME_RESULT", "OUTCOME_SHED", "RetryPolicy", "SCHED_CONTINUOUS",
+    "SCHED_DRAIN", "ServicePolicy",
     "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED", "SHED_QUEUE_FULL",
     "SolveRequest", "SolveService", "TransientDispatchError",
 ]

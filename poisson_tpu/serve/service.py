@@ -53,6 +53,8 @@ from poisson_tpu.serve.types import (
     OUTCOME_RESULT,
     OUTCOME_SHED,
     Outcome,
+    SCHED_CONTINUOUS,
+    SCHED_DRAIN,
     ServicePolicy,
     SHED_BREAKER_OPEN,
     SHED_DEADLINE_EXPIRED,
@@ -66,7 +68,8 @@ class _Entry:
     """Queue-resident lifecycle state for one admitted request."""
 
     __slots__ = ("request", "admitted_at", "deadline", "attempts",
-                 "taint", "not_before", "escalate", "last_failure")
+                 "taint", "not_before", "escalate", "last_failure",
+                 "iter_cap")
 
     def __init__(self, request: SolveRequest, admitted_at: float,
                  deadline: Optional[Deadline]):
@@ -78,6 +81,7 @@ class _Entry:
         self.not_before = 0.0      # backoff gate (service clock)
         self.escalate = False      # next dispatch via the resilient driver
         self.last_failure = ""
+        self.iter_cap = None       # degraded per-member cap (lane splices)
 
 
 def _percentile(sorted_vals: Sequence[float], q: float) -> float:
@@ -112,6 +116,13 @@ class SolveService:
             raise ValueError("service capacity must be >= 1")
         if self.policy.retry.max_attempts < 1:
             raise ValueError("retry.max_attempts must be >= 1")
+        if self.policy.scheduling not in (SCHED_DRAIN, SCHED_CONTINUOUS):
+            raise ValueError(
+                f"scheduling must be {SCHED_DRAIN!r} or "
+                f"{SCHED_CONTINUOUS!r}, got {self.policy.scheduling!r}"
+            )
+        if self.policy.refill_chunk < 1:
+            raise ValueError("refill_chunk must be >= 1")
         self._clock = clock
         self._sleep = sleep if sleep is not None else time.sleep
         self._rng = random.Random(seed)
@@ -125,6 +136,7 @@ class SolveService:
         self._latencies: List[float] = []
         self._counts = {"admitted": 0, "completed": 0, "errors": 0,
                         "shed": 0}
+        self._table = None   # continuous mode's live LaneTable (or None)
 
     # -- admission -----------------------------------------------------
 
@@ -163,32 +175,56 @@ class SolveService:
         returns every outcome reached during this drain, in completion
         order. Publishes the ``serve.*`` stats gauges afterwards."""
         start = len(self._order)
-        while self._step():
+        while self.pump():
             pass
         self._publish_stats()
         return [self._outcomes[rid] for rid in self._order[start:]]
 
-    def _step(self) -> bool:
+    def pump(self) -> bool:
+        """One scheduling step of the configured engine — a full
+        dispatch in drain mode, one chunk-and-refill cycle in continuous
+        mode. Returns False when no admitted request is pending. This is
+        the open-loop seam: a load generator interleaves ``submit`` with
+        ``pump`` so arrivals can join work already in flight
+        (``bench.py --serve --arrival-rate``)."""
+        if self.policy.scheduling == SCHED_CONTINUOUS:
+            return self._step_continuous()
+        return self._step()
+
+    def _advance_past_backoff(self) -> bool:
+        """Everything runnable is backing off: advance to the earliest
+        ready time (virtual clocks advance instantly; real clocks
+        sleep), force-promoting afterwards so a coarse injected clock
+        can never wedge the loop. Returns False when nothing is pending
+        at all."""
+        if not self._delayed:
+            return False
+        wait = max(0.0, min(e.not_before for e in self._delayed)
+                   - self._clock())
+        self._sleep(wait)
         self._pump_delayed()
-        if not self._queue:
-            if not self._delayed:
-                return False
-            # Everything pending is backing off: advance to the earliest
-            # ready time (virtual clocks advance instantly; real clocks
-            # sleep). Force-promote afterwards so a coarse injected clock
-            # can never wedge the loop.
-            wait = max(0.0, min(e.not_before for e in self._delayed)
-                       - self._clock())
-            self._sleep(wait)
-            self._pump_delayed()
-            if not self._queue and self._delayed:
-                self._delayed.sort(key=lambda e: e.not_before)
-                self._queue.append(self._delayed.pop(0))
+        if not self._queue and self._delayed:
+            self._delayed.sort(key=lambda e: e.not_before)
+            self._queue.append(self._delayed.pop(0))
+        return True
+
+    def _pop_live_head(self) -> Optional[_Entry]:
+        """Pop the queue head; a head whose deadline died while queued
+        is shed typed here (returns None — the ledger entry is closed)."""
         head = self._queue.popleft()
         if head.deadline is not None and head.deadline.expired():
             obs.inc("serve.deadline.expired_in_queue")
             self._shed(head, SHED_DEADLINE_EXPIRED,
                        "deadline expired while queued")
+            return None
+        return head
+
+    def _step(self) -> bool:
+        self._pump_delayed()
+        if not self._queue and not self._advance_past_backoff():
+            return False
+        head = self._pop_live_head()
+        if head is None:
             return True
         # Load is measured at dispatch-cycle start (head included), BEFORE
         # batch formation empties the queue — degradation responds to the
@@ -270,6 +306,250 @@ class SolveService:
         if frac >= d.shrink_padding_at:
             return 1
         return 0
+
+    # -- continuous batching (lane table + refill state machine) -------
+
+    def _lane_eligible(self, entry: _Entry) -> bool:
+        """Continuous mode: deadline-carrying requests ride lanes (the
+        engine's chunk boundary IS the deadline check), so only
+        explicitly-chunked requests and escalated divergence retries
+        (the resilient driver is single-request) still dispatch solo."""
+        return entry.request.chunk is None and not entry.escalate
+
+    def _effective_dtype(self, entry: _Entry, level: int) -> str:
+        """The dtype a lane splice would run this entry at — the
+        degradation ladder's precision downshift applied at the refill
+        decision, re-checked every time rather than once per batch."""
+        dtype = entry.request.dtype or "auto"
+        if level >= 3 and dtype == "float64":
+            return "float32"
+        return dtype
+
+    def _lane_cohort(self, entry: _Entry, level: int) -> str:
+        p = entry.request.problem
+        return f"{p.M}x{p.N}:{self._effective_dtype(entry, level)}:xla"
+
+    def _step_continuous(self) -> bool:
+        """One cycle of the refill engine: promote backed-off work,
+        dispatch a solo-class head, refill EMPTY lanes from the queue
+        (policy re-checked per splice), then advance every ACTIVE lane
+        one chunk and retire what the boundary shows as finished."""
+        self._pump_delayed()
+        busy = self._table is not None and self._table.occupied()
+        if not self._queue and not busy:
+            if not self._advance_past_backoff():
+                self._table = None
+                return False
+        # A solo-class head (escalated retry, explicit chunk) dispatches
+        # between chunk steps through the drain-mode machinery — the
+        # lane program pauses in wall time but burns no iterations.
+        if self._queue and not self._lane_eligible(self._queue[0]):
+            return self._dispatch_head_solo()
+        self._refill()
+        if self._table is not None and self._table.occupied():
+            self._step_lane_table()
+            return True
+        return bool(self._queue or self._delayed)
+
+    def _dispatch_head_solo(self) -> bool:
+        head = self._pop_live_head()
+        if head is None:
+            return True
+        level = self._load_level(len(self._queue) + len(self._delayed)
+                                 + 1)
+        breaker = self._breaker(self._cohort(head.request))
+        if not breaker.allow():
+            self._shed(head, SHED_BREAKER_OPEN,
+                       f"circuit breaker open for cohort "
+                       f"{self._cohort(head.request)}")
+            return True
+        self._dispatch([head], breaker, level)
+        return True
+
+    def _refill(self) -> None:
+        """The refill decision: splice queued, lane-eligible requests
+        into the live table's EMPTY lanes. Every policy is re-checked
+        per splice — deadline liveness, taint-pair exclusion against the
+        current occupants, the circuit breaker (denials counted as
+        ``serve.refill.refill_denied_by_breaker``), and the degradation
+        ladder (padding shrink at table creation, iteration cap and
+        precision downshift per spliced member). With no program in
+        flight, the table is (re)built for the queue head's cohort —
+        the same bucket executable is reused for every later splice."""
+        from poisson_tpu.serve.refill import LaneTable
+        from poisson_tpu.solvers.batched import bucket_size
+
+        if not self._queue:
+            return
+        level = self._load_level(len(self._queue) + len(self._delayed))
+        obs.gauge("serve.load_level", level)
+        head = self._queue[0]
+        head_cohort = self._lane_cohort(head, level)
+        from poisson_tpu.serve.breaker import OPEN
+
+        if self._breaker(head_cohort).state == OPEN:
+            # An OPEN breaker (cooldown still running) can admit nothing
+            # for this cohort: shed the head without paying lane-table
+            # construction for a program no splice could ever enter.
+            # (HALF_OPEN falls through — a probe splice is allowed.)
+            obs.inc("serve.refill.refill_denied_by_breaker")
+            entry = self._queue.popleft()
+            self._shed(entry, SHED_BREAKER_OPEN,
+                       f"circuit breaker open for cohort {head_cohort} "
+                       f"at refill")
+            return
+        ready = sum(
+            1 for e in self._queue
+            if self._lane_eligible(e)
+            and self._lane_cohort(e, level) == head_cohort
+            and e.request.problem == head.request.problem
+        )
+        if level >= 1:
+            # Padding shrink: size the table to the work actually
+            # waiting — no speculative lanes when every real member
+            # counts.
+            bucket = min(max(1, ready), self.policy.max_batch)
+        else:
+            # Size to the backlog, plus one speculative EMPTY lane
+            # (bucket ladder rounding) so an arrival can always join
+            # the running program mid-flight — that in-flight join is
+            # the continuous-batching win, and the idle width it costs
+            # is audible as serve.refill.idle_lane_steps.
+            bucket = bucket_size(
+                min(max(ready + 1, 2), self.policy.max_batch))
+        table = self._table
+        # An in-flight program is immutable (fixed executable width); an
+        # EMPTY one is replaceable — on cohort change, or to re-size the
+        # bucket to the backlog the load has grown (or shrunk) into.
+        if table is not None and not table.occupied() and (
+                table.cohort != head_cohort
+                or table.problem != head.request.problem
+                or table.bucket != bucket):
+            table = self._table = None
+        if table is None:
+            if level >= 1:
+                obs.inc("serve.degraded.padding")
+            eff_dtype = self._effective_dtype(head, level)
+            table = self._table = LaneTable(
+                head_cohort, head.request.problem,
+                None if eff_dtype == "auto" else eff_dtype,
+                bucket, self.policy.refill_chunk,
+            )
+            obs.event("serve.refill.table", cohort=head_cohort,
+                      bucket=bucket, level=level)
+        if not table.free_lane_count():
+            return
+        kept: deque = deque()
+        while self._queue and table.free_lane_count():
+            entry = self._queue.popleft()
+            if (not self._lane_eligible(entry)
+                    or self._lane_cohort(entry, level) != table.cohort
+                    or entry.request.problem != table.problem):
+                kept.append(entry)
+                continue
+            if entry.deadline is not None and entry.deadline.expired():
+                obs.inc("serve.deadline.expired_in_queue")
+                self._shed(entry, SHED_DEADLINE_EXPIRED,
+                           "deadline expired while queued")
+                continue
+            if not table.taint_compatible(entry):
+                kept.append(entry)     # waits for its taint partner
+                continue
+            breaker = self._breaker(table.cohort)
+            if not breaker.allow():
+                obs.inc("serve.refill.refill_denied_by_breaker")
+                self._shed(entry, SHED_BREAKER_OPEN,
+                           f"circuit breaker open for cohort "
+                           f"{table.cohort} at refill")
+                continue
+            if level >= 2:
+                entry.iter_cap = min(
+                    entry.request.problem.iteration_cap,
+                    self.policy.degradation.degraded_iteration_cap)
+                obs.inc("serve.degraded.iteration_cap")
+            else:
+                # Re-checked at every refill decision: a cap set while
+                # degraded must not stick to a retried entry splicing
+                # into a now-healthy service.
+                entry.iter_cap = None
+            if (level >= 3
+                    and (entry.request.dtype or "auto") == "float64"):
+                obs.inc("serve.degraded.precision")
+            table.splice(entry, entry.request.rhs_gate)
+        while kept:        # skipped entries return in arrival order
+            self._queue.appendleft(kept.pop())
+
+    def _step_lane_table(self) -> None:
+        """Advance the lane program one chunk through the dispatch-fault
+        seam, then classify the boundary. A transient fault kills the
+        device program: every occupant is evicted and retried with
+        mutual taint (the batch-drain contract, applied to lanes); an
+        internal fault surfaces every occupant as a typed error."""
+        table = self._table
+        breaker = self._breaker(table.cohort)
+        occupants = table.occupants()
+        try:
+            with obs.span("serve.refill.step", fence=False,
+                          cohort=table.cohort, active=len(occupants)):
+                if self._dispatch_fault is not None:
+                    self._dispatch_fault(
+                        [e.request for e in occupants],
+                        {e.request.request_id: e.attempts
+                         for e in occupants})
+                table.step()
+        except TransientDispatchError as e:
+            breaker.record_failure()
+            evicted = table.evict_all()
+            self._table = None
+            co_ids = {en.request.request_id for en in evicted}
+            for en in evicted:
+                self._retry_or_fail(en, ERROR_TRANSIENT, str(e),
+                                    co_ids - {en.request.request_id})
+            return
+        except Exception as e:  # internal: surfaced, never retried
+            breaker.record_failure()
+            evicted = table.evict_all()
+            self._table = None
+            for en in evicted:
+                self._error(en, ERROR_INTERNAL,
+                            f"{type(e).__name__}: {e}")
+            return
+        self._retire_boundary(table, breaker)
+
+    def _retire_boundary(self, table, breaker) -> None:
+        from poisson_tpu.solvers.pcg import FLAG_DEADLINE, FLAG_NONE
+
+        co_ids = table.occupant_ids()
+        any_failed = False
+        any_clean = False
+        for view in table.lane_view():
+            if view["member_id"] is None:
+                continue
+            entry = table.entries[view["lane"]]
+            cap = (entry.iter_cap if entry.iter_cap is not None
+                   else table.problem.iteration_cap)
+            deadline_hit = (entry.deadline is not None
+                            and entry.deadline.expired())
+            if not (view["done"] or view["k"] >= cap or deadline_hit):
+                continue               # still ACTIVE: rides the next chunk
+            entry, result = table.retire(view["lane"])
+            flag = result.flag
+            if deadline_hit and flag == FLAG_NONE:
+                # A healthy lane overtaken by its budget: partial result,
+                # deadline-flagged. Verdicts win over deadlines — the
+                # same precedence as checkpoint._deadline_flag.
+                flag = FLAG_DEADLINE
+            failed = self._classify_member(
+                entry, flag, result.iterations, result.diff,
+                restarts=0, cap=cap,
+                co_ids=co_ids - {entry.request.request_id},
+            )
+            any_failed = any_failed or failed
+            any_clean = any_clean or not failed
+        if any_failed:
+            breaker.record_failure()
+        elif any_clean:
+            breaker.record_success()
 
     # -- dispatch ------------------------------------------------------
 
@@ -544,7 +824,12 @@ class SolveService:
         invariant is ``lost == 0`` once the queue is drained), latency
         percentiles on the service clock, and the shed rate."""
         c = dict(self._counts)
-        pending = len(self._queue) + len(self._delayed)
+        # Pending = every admitted request without an outcome yet —
+        # queued, backing off, OR resident in a lane / mid-dispatch.
+        # _pending_ids is exactly that set (discarded only when the
+        # outcome is recorded), so the ledger stays honest when stats()
+        # is read mid-flight between pump() calls (the open-loop seam).
+        pending = len(self._pending_ids)
         lats = sorted(self._latencies)
         return {
             "admitted": c["admitted"],

@@ -156,16 +156,38 @@ class DegradationPolicy:
     downshift_precision_at: float = 0.9
 
 
+# Scheduling modes (ServicePolicy.scheduling):
+SCHED_DRAIN = "drain"            # PR 5 batch-drain: dispatch, wait, repeat
+SCHED_CONTINUOUS = "continuous"  # lane table + refill state machine
+
+
 @dataclasses.dataclass(frozen=True)
 class ServicePolicy:
     """Top-level service knobs: bounded queue ``capacity`` (admission
     beyond it sheds — typed, immediate, never unbounded growth),
     ``max_batch`` members per fused dispatch, ``default_chunk``
-    iterations between deadline checks on chunked dispatches."""
+    iterations between deadline checks on chunked dispatches.
+
+    ``scheduling`` selects the dispatch engine: ``"drain"`` (the PR 5
+    design — form a batch, run it to completion, form the next) or
+    ``"continuous"`` (Orca-style in-flight refill — a lane table steps
+    the fused program ``refill_chunk`` iterations at a time, retires
+    converged lanes to their typed outcomes at each boundary, and
+    splices queued RHS into the freed lanes of the same bucket
+    executable; breaker/degradation/taint policies are re-checked at
+    every refill decision). Both engines uphold the same ledger
+    invariant; ``drain`` stays the default so the two are A/B-comparable
+    (``bench.py --serve --arrival-rate`` measures exactly that).
+    ``refill_chunk`` is the continuous engine's iterations-per-step —
+    smaller means fresher refill decisions and tighter deadline
+    enforcement, at more host round-trips.
+    """
 
     capacity: int = 64
     max_batch: int = 32
     default_chunk: int = 50
+    scheduling: str = SCHED_DRAIN
+    refill_chunk: int = 25
     retry: RetryPolicy = RetryPolicy()
     breaker: BreakerPolicy = BreakerPolicy()
     degradation: DegradationPolicy = DegradationPolicy()

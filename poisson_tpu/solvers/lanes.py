@@ -1,0 +1,305 @@
+"""Lane-carry stepping for continuous batching: the resumable batched PCG.
+
+``solvers.batched`` runs a whole bucket to completion in one fused
+``while_loop`` — batch-drain: a member that converges at iteration 40
+holds its lane idle until the slowest member stops. This module is the
+solver half of the Orca-style fix (iteration-level scheduling, PAPERS.md):
+the same vmapped body, the same per-member masking, but driven as a
+**resumable stepping program** — ``step()`` advances every lane by at most
+``chunk`` iterations and returns to the host, where converged lanes can be
+retired and fresh right-hand sides spliced into the freed slots of the
+*same* compiled executable. No recompile, no restart of in-flight members.
+
+Three facts make the splice sound, and the tests pin all of them:
+
+1. **Per-member independence.** Every reduction in the ops bundle is
+   per-member (trailing-axes sums), so lane *i*'s iterate trajectory is a
+   pure function of lane *i*'s state — writing a new member into lane *j*
+   cannot perturb lane *i* by even an ULP.
+2. **Chunk-invariance.** The stepping body freezes a member at its own
+   ``stop_at = min(k + chunk, cap)``; re-entering the loop from carried
+   state continues the exact sequence (the same argument that makes
+   ``checkpoint.run_chunked`` bit-exact, vectorized per lane).
+3. **Identity conservation.** ``origin[lane]`` carries the member id
+   through every splice/retire; a retired lane's result is attributable
+   to exactly one id, and an EMPTY lane (``origin[lane] is None``) is a
+   pre-stopped zero member the loop never advances.
+
+The lane lifecycle (state diagram in README "Solve service"):
+EMPTY → (splice) → ACTIVE → (converged/cap/deadline/verdict at a chunk
+boundary) → RETIRING → (result read, slot cleared) → EMPTY. RETIRING is
+host-synchronous — it exists between ``step()`` returning and
+``retire()`` clearing the slot — which is what makes "nothing is ever
+lost" checkable: a lane is only ever EMPTY or attributed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from poisson_tpu.config import Problem
+from poisson_tpu.solvers.pcg import (
+    FLAG_NAMES,
+    PCGState,
+    host_setup,
+    init_state,
+    make_pcg_body,
+    resolve_dtype,
+    resolve_scaled,
+    scaled_single_device_ops,
+    single_device_ops,
+)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _member_init(problem: Problem, scaled: bool, a, b, aux,
+                 rhs) -> PCGState:
+    """One member's ``init_state`` under jit — the same compiled
+    arithmetic as the fused solvers' inits, so a spliced member starts
+    from byte-identical state."""
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    return init_state(ops, rhs)
+
+
+# The lane index is a TRACED operand in both lane-slot programs: one
+# compiled executable serves every lane of a bucket (a static Python
+# index would compile bucket × leaf-count tiny programs and turn each
+# splice/retire into a stack of dispatches — measured ~16 ms per
+# operation on CPU, dwarfing the chunk compute it brackets).
+
+@jax.jit
+def _set_lane(state: PCGState, lane, member: PCGState) -> PCGState:
+    """Write ``member``'s per-lane state into slot ``lane``."""
+    return jax.tree_util.tree_map(
+        lambda full, one: full.at[lane].set(one), state, member)
+
+
+@jax.jit
+def _take_lane(state: PCGState, lane,
+               blank: PCGState) -> tuple[PCGState, PCGState]:
+    """Read slot ``lane`` out and clear it to ``blank`` in one program:
+    (member_state, state_with_lane_emptied)."""
+    member = jax.tree_util.tree_map(lambda leaf: leaf[lane], state)
+    cleared = jax.tree_util.tree_map(
+        lambda full, one: full.at[lane].set(one), state, blank)
+    return member, cleared
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _step_lanes(problem: Problem, scaled: bool, chunk: int,
+                a, b, aux, state: PCGState) -> PCGState:
+    """Advance every lane by at most ``chunk`` of ITS OWN iterations.
+
+    Exactly ``solvers.batched.pcg_loop_batched``'s masked vmapped body,
+    but the stop line is per-member and relative to the carried state:
+    ``stop_at[i] = min(k[i] + chunk, cap)``. A lane that was spliced in
+    mid-flight (k=0) and a lane 200 iterations deep each get ``chunk``
+    more iterations; done lanes (converged, verdict, or EMPTY) stay
+    frozen. Compiled once per (bucket, grid, dtype, scaled, chunk) — the
+    executable every refill of the same bucket reuses.
+    """
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    body = make_pcg_body(
+        ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+    vbody = jax.vmap(body)
+    stop_at = jnp.minimum(state.k + chunk, problem.iteration_cap)
+
+    def masked_body(s: PCGState) -> PCGState:
+        stepped = vbody(s)
+        frozen = s.done | (s.k >= stop_at)
+
+        def keep(old, new):
+            pred = frozen.reshape(frozen.shape + (1,) * (new.ndim - 1))
+            return jnp.where(pred, old, new)
+
+        return jax.tree_util.tree_map(keep, s, stepped)
+
+    def cond(s: PCGState):
+        return jnp.any((~s.done) & (s.k < stop_at))
+
+    return lax.while_loop(cond, masked_body, state)
+
+
+class LaneResult(NamedTuple):
+    """One retired lane's attributable outcome (host-side values)."""
+
+    member_id: object         # the id given at splice time — never None
+    lane: int
+    w: jnp.ndarray            # solution grid, scaling already unapplied
+    iterations: int
+    diff: float
+    residual_dot: float
+    flag: int                 # solvers.pcg FLAG_* verdict at retirement
+
+    @property
+    def flag_name(self) -> str:
+        return FLAG_NAMES.get(self.flag, str(self.flag))
+
+
+class LaneBatch:
+    """A fixed-width bucket of solve lanes driven chunk by chunk.
+
+    ``splice(member_id, rhs_gate)`` loads a member into a free lane (its
+    RHS is the problem's, scaled by ``rhs_gate`` — byte-identical to what
+    ``solve_batched(problem, rhs_gates=[g])`` would build, so a spliced
+    member's iterates match an unrefilled solve of the same member
+    bit-for-bit); ``step()`` advances every lane by at most ``chunk``
+    iterations; ``lane_view()`` reads the per-lane (k, done, flag, diff)
+    truth; ``retire(lane)`` extracts the attributed result and returns
+    the lane to EMPTY. The caller owns the schedule — this class only
+    guarantees that any interleaving of splice/step/retire conserves
+    lane identity and member trajectories.
+    """
+
+    def __init__(self, problem: Problem, bucket: int, *, dtype=None,
+                 scaled=None, chunk: int = 50):
+        if bucket < 1:
+            raise ValueError(f"bucket must be >= 1, got {bucket}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.problem = problem
+        self.bucket = int(bucket)
+        self.chunk = int(chunk)
+        self.dtype_name = resolve_dtype(dtype)
+        self.use_scaled = resolve_scaled(scaled, self.dtype_name)
+        # f_val never enters the traced program (the RHS is a traced
+        # operand) — normalize it out of the static jit key exactly like
+        # solve_batched, so lane programs share executables across RHS
+        # magnitudes.
+        self._jit_problem = problem.with_(f_val=1.0)
+        a, b, rhs, aux = host_setup(problem, self.dtype_name,
+                                    self.use_scaled)
+        self._a, self._b, self._aux = a, b, aux
+        self._rhs = rhs               # includes problem.f_val
+        self._ops = (
+            scaled_single_device_ops(self._jit_problem, a, b, aux)
+            if self.use_scaled
+            else single_device_ops(self._jit_problem, a, b, aux)
+        )
+        # All lanes start EMPTY: a zero member, pre-stopped, never advanced.
+        zeros = jnp.zeros((self.bucket,) + problem.grid_shape,
+                          jnp.dtype(self.dtype_name))
+        init = jax.vmap(functools.partial(init_state, self._ops))(zeros)
+        self.state: PCGState = init._replace(
+            done=jnp.ones((self.bucket,), bool))
+        self._blank = jax.tree_util.tree_map(lambda leaf: leaf[0],
+                                             self.state)
+        self.origin: List[object] = [None] * self.bucket
+        self.steps = 0                # chunk steps executed
+        self.idle_lane_steps = 0      # Σ over steps of non-ACTIVE lanes
+
+    # -- occupancy -----------------------------------------------------
+
+    def free_lanes(self) -> List[int]:
+        return [i for i, m in enumerate(self.origin) if m is None]
+
+    def active_lanes(self) -> List[int]:
+        return [i for i, m in enumerate(self.origin) if m is not None]
+
+    def occupied(self) -> bool:
+        return any(m is not None for m in self.origin)
+
+    # -- the state machine ---------------------------------------------
+
+    def splice(self, member_id, rhs_gate: float = 1.0,
+               lane: Optional[int] = None) -> int:
+        """EMPTY → ACTIVE: load ``member_id``'s solve into a free lane.
+
+        The member's init state is the sequential solver's ``init_state``
+        of ``rhs · rhs_gate`` — the same arrays ``solve_batched`` stacks,
+        so per-member independence (module docstring) makes the spliced
+        trajectory identical to an unrefilled solve. Returns the lane.
+        """
+        if member_id is None:
+            raise ValueError("member_id must not be None (None marks an "
+                             "EMPTY lane)")
+        if member_id in self.origin:
+            raise ValueError(f"member {member_id!r} already occupies lane "
+                             f"{self.origin.index(member_id)}")
+        if lane is None:
+            free = self.free_lanes()
+            if not free:
+                raise ValueError("no EMPTY lane to splice into")
+            lane = free[0]
+        elif self.origin[lane] is not None:
+            raise ValueError(f"lane {lane} is ACTIVE (member "
+                             f"{self.origin[lane]!r})")
+        rhs = self._rhs * jnp.asarray(rhs_gate, self._rhs.dtype)
+        member = _member_init(self._jit_problem, self.use_scaled,
+                              self._a, self._b, self._aux, rhs)
+        self.state = _set_lane(self.state, jnp.asarray(lane, jnp.int32),
+                               member)
+        self.origin[lane] = member_id
+        return lane
+
+    def step(self) -> dict:
+        """Advance every ACTIVE lane by at most ``chunk`` iterations.
+
+        Returns host-side accounting: ``{"active": n, "idle": n}`` for
+        the step just taken (idle lanes are EMPTY slots whose width the
+        fused program still computes — the utilization cost continuous
+        refill exists to keep low).
+        """
+        active = len(self.active_lanes())
+        idle = self.bucket - active
+        if active:
+            self.state = _step_lanes(self._jit_problem, self.use_scaled,
+                                     self.chunk, self._a, self._b,
+                                     self._aux, self.state)
+            self.steps += 1
+            self.idle_lane_steps += idle
+        return {"active": active, "idle": idle}
+
+    def lane_view(self) -> List[dict]:
+        """Host-readable per-lane truth after a step: one dict per lane
+        with ``lane``/``member_id``/``k``/``done``/``flag``/``diff``
+        (EMPTY lanes included, ``member_id=None``)."""
+        ks = np.asarray(self.state.k)
+        dones = np.asarray(self.state.done)
+        flags = np.asarray(self.state.flag)
+        diffs = np.asarray(self.state.diff)
+        return [
+            {"lane": i, "member_id": self.origin[i], "k": int(ks[i]),
+             "done": bool(dones[i]), "flag": int(flags[i]),
+             "diff": float(diffs[i])}
+            for i in range(self.bucket)
+        ]
+
+    def retire(self, lane: int) -> LaneResult:
+        """ACTIVE → RETIRING → EMPTY: extract the lane's attributed
+        result and clear the slot for the next splice. The caller decides
+        *when* (converged, verdict, cap, deadline) — retirement itself is
+        unconditional so a poisoned or deadlined member can always be
+        pulled out with its partial iterate intact."""
+        member_id = self.origin[lane]
+        if member_id is None:
+            raise ValueError(f"lane {lane} is already EMPTY")
+        member, self.state = _take_lane(self.state,
+                                        jnp.asarray(lane, jnp.int32),
+                                        self._blank)
+        w = member.w * self._aux if self.use_scaled else member.w
+        result = LaneResult(
+            member_id=member_id, lane=lane, w=w,
+            iterations=int(member.k),
+            diff=float(member.diff),
+            residual_dot=float(member.zr),
+            flag=int(member.flag),
+        )
+        self.origin[lane] = None
+        return result

@@ -37,6 +37,21 @@ corrupt-checkpoint-resume   preempt + bit-flip the newest checkpoint →
                             resume falls back a generation, bit-exact
 stall-watchdog              a wedged chunk trips the watchdog while a
                             generous deadline stays out of the way
+refill-poison-splice        continuous batching: a poison member spliced
+                            into a RUNNING lane program kills the step;
+                            the in-flight victim is retried and
+                            converges, the poison gets a typed error
+refill-deadline-mid-splice  a lane member's deadline expires mid-flight
+                            (partial, flagged ``deadline``); a request
+                            starved behind occupied lanes sheds at the
+                            refill decision
+refill-taint-across-splice  taint-pair exclusion holds ACROSS splices:
+                            after a batch kill, no two mutually tainted
+                            requests are ever lane-co-resident again
+refill-preempt-occupied     a preemption with occupied lanes surfaces
+                            every occupant as a typed error, trips the
+                            breaker (refill denials counted), and the
+                            breaker recovers through the refill path
 ==========================  ============================================
 
 Every scenario resets the metrics registry, runs against a
@@ -546,6 +561,209 @@ def _stall_watchdog(seed: int) -> dict:
         "deadline_stayed_quiet": int(res.flag) == FLAG_CONVERGED
         and int(res.iterations) == 50,
     }, {"stall_diag_beats": fired[0]["beats"] if fired else None})
+
+
+# -- continuous-batching refill races -----------------------------------
+# All four drive ServicePolicy(scheduling="continuous"): the lane table
+# (serve.refill) with converged lanes retiring and queued RHS splicing
+# into a RUNNING bucket executable. Every scenario's invariant is still
+# admitted − (completed + errors + shed) == 0, read from the snapshot.
+
+
+def _continuous_policy(**kw):
+    from poisson_tpu.serve import SCHED_CONTINUOUS, ServicePolicy
+
+    kw.setdefault("degradation", _quiet_degradation())
+    return ServicePolicy(scheduling=SCHED_CONTINUOUS, **kw)
+
+
+@scenario("refill-poison-splice")
+def _refill_poison_splice(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        OUTCOME_ERROR,
+        RetryPolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import poison_batch_fault
+
+    vc = VirtualClock()
+    svc = SolveService(
+        _continuous_policy(
+            capacity=16, max_batch=2, refill_chunk=10,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=poison_batch_fault({"poison"}),
+    )
+    p = _problem()
+    # The race this scenario exists for: innocent-0 is 20 iterations
+    # into a lane program (two pumped chunks) when the poison arrives
+    # and splices into the free lane of the SAME running executable —
+    # its kill lands on a genuinely in-flight victim, not a fresh batch.
+    svc.submit(SolveRequest(request_id="innocent-0", problem=p))
+    svc.pump()
+    svc.pump()
+    svc.submit(SolveRequest(request_id="poison", problem=p))
+    svc.submit(SolveRequest(request_id="innocent-1", problem=p,
+                            rhs_gate=1.1))
+    outs = {o.request_id: o for o in svc.drain()}
+    poison = outs["poison"]
+    innocents = [outs[f"innocent-{i}"] for i in range(2)]
+    return _finish("refill-poison-splice", seed, {
+        "poison_got_typed_error": poison.kind == OUTCOME_ERROR
+        and poison.error_type == "transient" and poison.attempts == 3,
+        "in_flight_victim_recovered": outs["innocent-0"].converged
+        and outs["innocent-0"].attempts == 2,
+        "all_innocents_converged": all(o.converged for o in innocents),
+        "splices_counted": _counter("serve.refill.splices") >= 5,
+        "retired_lanes_counted":
+            _counter("serve.refill.retired_lanes") >= 2,
+        "requeues_isolated": _counter("serve.requeued.isolated") >= 2,
+    }, {"poison_attempts": poison.attempts,
+        "innocent_attempts": [o.attempts for o in innocents],
+        "splices": _counter("serve.refill.splices")})
+
+
+@scenario("refill-deadline-mid-splice")
+def _refill_deadline_mid_splice(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        OUTCOME_RESULT,
+        OUTCOME_SHED,
+        SolveRequest,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+    svc = SolveService(
+        _continuous_policy(capacity=16, max_batch=2, refill_chunk=10),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        # Each chunk step costs 0.3 virtual seconds: the lane engine's
+        # boundary is where deadlines are observed.
+        dispatch_fault=lambda requests, attempts: vc.advance(0.3),
+    )
+    p = _problem()
+    svc.submit(SolveRequest(request_id="fits", problem=p))
+    svc.submit(SolveRequest(request_id="mid", problem=p, rhs_gate=1.1,
+                            deadline_seconds=1.0))
+    svc.submit(SolveRequest(request_id="starved", problem=p,
+                            deadline_seconds=0.5))
+    outs = {o.request_id: o for o in svc.drain()}
+    mid, starved = outs["mid"], outs["starved"]
+    return _finish("refill-deadline-mid-splice", seed, {
+        "lane_deadline_went_partial": mid.kind == OUTCOME_RESULT
+        and mid.flag == "deadline" and mid.partial
+        and not mid.converged,
+        "stopped_mid_flight": 0 < mid.iterations < 50,
+        "mid_flight_expiry_counted":
+            _counter("serve.deadline.expired_mid_solve") == 1,
+        "starved_behind_occupied_lanes_shed":
+            starved.kind == OUTCOME_SHED
+            and starved.shed_reason == "deadline_expired",
+        "undeadlined_member_converged": outs["fits"].converged,
+    }, {"mid_iterations": mid.iterations,
+        "fits_iterations": outs["fits"].iterations})
+
+
+@scenario("refill-taint-across-splice")
+def _refill_taint_across_splice(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        OUTCOME_ERROR,
+        RetryPolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import compose_faults, poison_batch_fault
+
+    co_resident: list = []
+
+    def record(requests, attempts):
+        co_resident.append({r.request_id for r in requests})
+
+    vc = VirtualClock()
+    svc = SolveService(
+        _continuous_policy(
+            capacity=16, max_batch=4, refill_chunk=10,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=compose_faults(record,
+                                      poison_batch_fault({"bad"})),
+    )
+    p = _problem()
+    svc.submit(SolveRequest(request_id="bad", problem=p))
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=f"mate-{i}", problem=p,
+                                rhs_gate=1.0 + i / 10))
+    outs = {o.request_id: o for o in svc.drain()}
+    # The first kill mutually taints everything co-resident with it;
+    # from then on no step may ever see two of those ids share lanes.
+    kill_at = next(i for i, ids in enumerate(co_resident) if "bad" in ids)
+    tainted = co_resident[kill_at]
+    violations = [ids for ids in co_resident[kill_at + 1:]
+                  if len(ids & tainted) > 1]
+    return _finish("refill-taint-across-splice", seed, {
+        "kill_saw_full_lanes": len(tainted) == 4,
+        "tainted_pairs_never_co_resident_again": not violations,
+        "mates_converged": all(outs[f"mate-{i}"].converged
+                               for i in range(3)),
+        "bad_got_typed_error": outs["bad"].kind == OUTCOME_ERROR
+        and outs["bad"].error_type == "transient",
+    }, {"steps_observed": len(co_resident),
+        "violations": [sorted(map(str, v)) for v in violations]})
+
+
+@scenario("refill-preempt-occupied")
+def _refill_preempt_occupied(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        BreakerPolicy,
+        CLOSED,
+        OUTCOME_ERROR,
+        SolveRequest,
+        SolveService,
+    )
+
+    boom = {"armed": True}
+
+    def preempt_once(requests, attempts):
+        if boom["armed"] and len(requests) >= 2:
+            boom["armed"] = False
+            raise RuntimeError("injected preemption with occupied lanes")
+
+    vc = VirtualClock()
+    svc = SolveService(
+        _continuous_policy(
+            capacity=16, max_batch=4, refill_chunk=10,
+            breaker=BreakerPolicy(failure_threshold=1,
+                                  cooldown_seconds=10.0),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=preempt_once,
+    )
+    p = _problem()
+    for i in range(4):
+        svc.submit(SolveRequest(request_id=i, problem=p,
+                                rhs_gate=1.0 + i / 10))
+    svc.submit(SolveRequest(request_id="denied", problem=p))
+    outs = {o.request_id: o for o in svc.drain()}
+    errors = [outs[i] for i in range(4)]
+    vc.advance(10.5)               # cooldown passes → half-open probe
+    svc.submit(SolveRequest(request_id="after", problem=p))
+    (after,) = svc.drain()
+    cohort = "40x40:auto:xla"
+    return _finish("refill-preempt-occupied", seed, {
+        "occupants_got_typed_internal_errors": all(
+            o.kind == OUTCOME_ERROR and o.error_type == "internal"
+            and "preemption" in o.message for o in errors),
+        "errors_counted": _counter("serve.errors.internal") == 4,
+        "refill_denied_by_breaker":
+            _counter("serve.refill.refill_denied_by_breaker") == 1
+            and outs["denied"].shed_reason == "breaker_open",
+        "breaker_recovered_through_refill": after.converged
+        and svc.stats()["breakers"][cohort] == CLOSED,
+    }, {"after_iterations": after.iterations})
 
 
 # -- campaign runner ----------------------------------------------------

@@ -1,0 +1,323 @@
+"""Continuous batching: lane-identity conservation, splice bit-parity,
+and the refill engine's ledger guarantees (tier-1, CPU; -m serve).
+
+The load-bearing property: ANY interleaving of retire/splice over a
+seeded schedule keeps the ``PCGResult.origin`` → request-id mapping
+exact, and every member's iterate values bit-identical to an unrefilled
+solve of the same member — per-member independence plus
+chunk-invariance, the two facts that make in-flight refill sound.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.obs import metrics
+from poisson_tpu.solvers.lanes import LaneBatch
+from poisson_tpu.solvers.pcg import FLAG_CONVERGED, pcg_solve
+
+pytestmark = pytest.mark.serve
+
+PROBLEM = Problem(M=32, N=32)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    yield
+    metrics.reset()
+
+
+# -- solver layer: LaneBatch ------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_any_retire_splice_interleaving_is_bit_exact(seed):
+    """Property-style: a seeded random schedule of splices (whenever a
+    lane is free, with random reluctance) and retires (whenever a lane
+    is done) over a 3-lane program must (a) never let two lanes carry
+    the same member, (b) attribute every retired result to the exact
+    member id spliced, and (c) reproduce the sequential solver's
+    iterates bit-for-bit for EVERY member, no matter where in another
+    member's flight it was spliced in."""
+    rng = random.Random(seed)
+    gates = {f"req-{i}": 1.0 + i / 7 for i in range(8)}
+    golden = {mid: pcg_solve(PROBLEM, dtype="float32", rhs_gate=g)
+              for mid, g in gates.items()}
+    lb = LaneBatch(PROBLEM, bucket=3, dtype="float32",
+                   chunk=rng.choice([3, 7, 11]))
+    queue = list(gates)
+    results = {}
+    for _ in range(2000):
+        if len(results) == len(gates):
+            break
+        for view in lb.lane_view():
+            if view["member_id"] is not None and view["done"]:
+                res = lb.retire(view["lane"])
+                assert res.member_id == view["member_id"]
+                results[res.member_id] = res
+        while queue and lb.free_lanes() and rng.random() < 0.7:
+            lb.splice(queue[0], gates[queue.pop(0)])
+        occupied = [m for m in lb.origin if m is not None]
+        assert len(occupied) == len(set(occupied))
+        lb.step()
+    assert len(results) == len(gates), "schedule did not drain"
+    for mid, res in results.items():
+        ref = golden[mid]
+        assert res.iterations == int(ref.iterations), mid
+        assert res.flag == int(ref.flag) == FLAG_CONVERGED, mid
+        assert np.array_equal(np.asarray(res.w), np.asarray(ref.w)), (
+            f"member {mid} drifted from its unrefilled solve")
+
+
+def test_mid_flight_splice_does_not_perturb_the_resident_member():
+    """The core splice soundness claim, isolated: a member 2 chunks deep
+    when another splices in next to it finishes bit-identical to its
+    solo solve — and so does the late joiner."""
+    lb = LaneBatch(PROBLEM, bucket=2, dtype="float32", chunk=10)
+    lb.splice("early", 1.0)
+    lb.step()
+    lb.step()                       # "early" is 20 iterations in
+    lb.splice("late", 1.5)
+    results = {}
+    for _ in range(50):
+        lb.step()
+        for view in lb.lane_view():
+            if view["member_id"] is not None and view["done"]:
+                res = lb.retire(view["lane"])
+                results[res.member_id] = res
+        if not lb.occupied():
+            break
+    for mid, gate in (("early", 1.0), ("late", 1.5)):
+        ref = pcg_solve(PROBLEM, dtype="float32", rhs_gate=gate)
+        assert results[mid].iterations == int(ref.iterations)
+        assert np.array_equal(np.asarray(results[mid].w),
+                              np.asarray(ref.w))
+
+
+def test_step_budget_is_per_lane_not_global():
+    """A freshly spliced lane gets its own ``chunk`` iterations even
+    when its neighbours are deep into theirs: stop_at is relative to
+    each lane's carried k."""
+    lb = LaneBatch(PROBLEM, bucket=2, dtype="float32", chunk=10)
+    lb.splice("a", 1.0)
+    lb.step()
+    lb.splice("b", 1.2)
+    lb.step()
+    view = {v["member_id"]: v for v in lb.lane_view()}
+    assert view["a"]["k"] == 20
+    assert view["b"]["k"] == 10
+
+
+def test_lane_occupancy_errors():
+    lb = LaneBatch(PROBLEM, bucket=2, dtype="float32")
+    lb.splice("a", 1.0, lane=0)
+    with pytest.raises(ValueError, match="already occupies"):
+        lb.splice("a", 1.0)
+    with pytest.raises(ValueError, match="ACTIVE"):
+        lb.splice("b", 1.0, lane=0)
+    with pytest.raises(ValueError, match="EMPTY lane"):
+        lb.splice(None, 1.0)
+    with pytest.raises(ValueError, match="already EMPTY"):
+        lb.retire(1)
+    lb.splice("b", 1.0)
+    with pytest.raises(ValueError, match="no EMPTY lane"):
+        lb.splice("c", 1.0)
+    with pytest.raises(ValueError):
+        LaneBatch(PROBLEM, bucket=0)
+    with pytest.raises(ValueError):
+        LaneBatch(PROBLEM, bucket=2, chunk=0)
+
+
+# -- service layer: the continuous engine ------------------------------
+
+
+def _quiet():
+    from poisson_tpu.serve import DegradationPolicy
+
+    return DegradationPolicy(shrink_padding_at=9.0, cap_iterations_at=9.0,
+                             downshift_precision_at=9.0)
+
+
+def _service(scheduling, **kw):
+    from poisson_tpu.serve import ServicePolicy, SolveService
+    from poisson_tpu.testing.chaos import VirtualClock
+
+    vc = VirtualClock()
+    kw.setdefault("degradation", _quiet())
+    svc = SolveService(
+        ServicePolicy(scheduling=scheduling, **kw),
+        clock=vc, sleep=vc.sleep, seed=0,
+    )
+    return svc, vc
+
+
+def test_continuous_and_drain_agree_on_outcomes():
+    """Same six requests through both engines: identical converged set
+    and identical per-request iteration counts — scheduling must change
+    wall-clock shape, never answers."""
+    from poisson_tpu.serve import SCHED_CONTINUOUS, SCHED_DRAIN, SolveRequest
+
+    per_mode = {}
+    for mode in (SCHED_DRAIN, SCHED_CONTINUOUS):
+        svc, _ = _service(mode, max_batch=4, refill_chunk=10)
+        for i in range(6):
+            svc.submit(SolveRequest(request_id=i, problem=PROBLEM,
+                                    rhs_gate=1.0 + i / 10,
+                                    dtype="float32"))
+        outs = svc.drain()
+        assert svc.stats()["lost"] == 0
+        per_mode[mode] = {o.request_id: (o.converged, o.iterations)
+                          for o in outs}
+    assert per_mode[SCHED_DRAIN] == per_mode[SCHED_CONTINUOUS]
+    assert all(c for c, _ in per_mode[SCHED_CONTINUOUS].values())
+
+
+def test_open_loop_arrival_joins_mid_flight():
+    """The pump() seam: request 0 is two chunks deep when 1 and 2 are
+    submitted — they must splice into the running program (no new table)
+    and every ledger entry must close."""
+    from poisson_tpu.serve import SCHED_CONTINUOUS, SolveRequest
+
+    svc, _ = _service(SCHED_CONTINUOUS, max_batch=4, refill_chunk=10)
+    svc.submit(SolveRequest(request_id=0, problem=PROBLEM,
+                            dtype="float32"))
+    svc.pump()
+    svc.pump()
+    table = svc._table
+    assert table is not None and table.occupied()
+    for i in (1, 2):
+        svc.submit(SolveRequest(request_id=i, problem=PROBLEM,
+                                rhs_gate=1.0 + i / 10, dtype="float32"))
+    outs = svc.drain()
+    assert svc._table is table or svc._table is None  # no rebuild race
+    assert sorted(o.request_id for o in outs) == [0, 1, 2]
+    assert all(o.converged for o in outs)
+    assert metrics.get("serve.refill.splices") == 3
+    assert metrics.get("serve.refill.retired_lanes") == 3
+    assert svc.stats()["lost"] == 0
+
+
+def test_continuous_iterations_match_solo_solves():
+    """Identity + trajectory conservation at the service level: each
+    outcome's iteration count equals the sequential solve of the same
+    rhs_gate, after riding lanes through refills."""
+    from poisson_tpu.serve import SCHED_CONTINUOUS, SolveRequest
+
+    gates = {i: 1.0 + i / 9 for i in range(7)}
+    svc, _ = _service(SCHED_CONTINUOUS, max_batch=2, refill_chunk=15)
+    for i, g in gates.items():
+        svc.submit(SolveRequest(request_id=i, problem=PROBLEM,
+                                rhs_gate=g, dtype="float32"))
+    outs = {o.request_id: o for o in svc.drain()}
+    for i, g in gates.items():
+        ref = pcg_solve(PROBLEM, dtype="float32", rhs_gate=g)
+        assert outs[i].converged
+        assert outs[i].iterations == int(ref.iterations)
+
+
+def test_ledger_is_honest_mid_flight():
+    """stats() between pump() calls — the documented open-loop reading —
+    must count a lane-resident request as pending, never as lost."""
+    from poisson_tpu.serve import SCHED_CONTINUOUS, SolveRequest
+
+    svc, _ = _service(SCHED_CONTINUOUS, max_batch=2, refill_chunk=10)
+    svc.submit(SolveRequest(request_id="r", problem=PROBLEM,
+                            dtype="float32"))
+    svc.pump()                      # "r" is in a lane, mid-flight
+    s = svc.stats()
+    assert s["pending"] == 1
+    assert s["lost"] == 0
+    svc.drain()
+    s = svc.stats()
+    assert s["pending"] == 0 and s["lost"] == 0 and s["completed"] == 1
+
+
+def test_scheduling_policy_validation():
+    from poisson_tpu.serve import ServicePolicy, SolveService
+
+    with pytest.raises(ValueError, match="scheduling"):
+        SolveService(ServicePolicy(scheduling="sometimes"))
+    with pytest.raises(ValueError, match="refill_chunk"):
+        SolveService(ServicePolicy(refill_chunk=0))
+
+
+# -- regression sentinel: metric directions ----------------------------
+
+
+def _regress():
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from benchmarks import regress
+
+    return regress
+
+
+def _serve_rec(regress, metric, value, rate=80.0, fault="clean"):
+    return regress.record_from_result(
+        {"metric": metric, "value": value,
+         "detail": {"grid": [96, 144], "dtype": "float32",
+                    "backend": "xla_serve", "devices": 1,
+                    "platform": "cpu", "fault_load": fault,
+                    "arrival_rate": rate}},
+        source=f"t:{metric}:{value}:{rate}:{fault}",
+    )
+
+
+def test_regress_pins_sustained_higher_is_better():
+    regress = _regress()
+    base = [_serve_rec(regress, "serve.sustained_solves_per_sec", v)
+            for v in (60.0, 62.0, 61.0)]
+    drop = regress.evaluate(
+        base + [_serve_rec(regress, "serve.sustained_solves_per_sec",
+                           30.0)])
+    assert drop["verdict"] == "regression"
+    rise = regress.evaluate(
+        base + [_serve_rec(regress, "serve.sustained_solves_per_sec",
+                           120.0)])
+    assert rise["verdict"] == "ok"
+
+
+def test_regress_pins_p99_lower_is_better():
+    regress = _regress()
+    base = [_serve_rec(regress, "serve.p99_latency", v, rate=None)
+            for v in (0.2, 0.21, 0.19)]
+    grew = regress.evaluate(
+        base + [_serve_rec(regress, "serve.p99_latency", 0.5,
+                           rate=None)])
+    assert grew["verdict"] == "regression"
+    shrank = regress.evaluate(
+        base + [_serve_rec(regress, "serve.p99_latency", 0.05,
+                           rate=None)])
+    assert shrank["verdict"] == "ok"
+
+
+def test_regress_splits_cohorts_by_arrival_rate_and_fault_load():
+    """A sustained-throughput record at one offered load (or fault mix)
+    must never be judged against another's baseline: with no same-rate
+    sibling it is ``no_baseline``, not a regression."""
+    regress = _regress()
+    records = [
+        _serve_rec(regress, "serve.sustained_solves_per_sec", 60.0,
+                   rate=80.0),
+        _serve_rec(regress, "serve.sustained_solves_per_sec", 61.0,
+                   rate=80.0),
+        # Far lower value, but a different arrival rate — own cohort.
+        _serve_rec(regress, "serve.sustained_solves_per_sec", 10.0,
+                   rate=200.0),
+        # Same rate, different fault mix — own cohort as well.
+        _serve_rec(regress, "serve.sustained_solves_per_sec", 9.0,
+                   rate=80.0, fault="poison2"),
+    ]
+    report = regress.evaluate(records)
+    assert report["verdict"] == "ok"
+    cls = {v["source"]: v["classification"] for v in report["records"]}
+    assert cls["t:serve.sustained_solves_per_sec:10.0:200.0:clean"] == \
+        "no_baseline"
+    assert cls["t:serve.sustained_solves_per_sec:9.0:80.0:poison2"] == \
+        "no_baseline"

@@ -57,7 +57,9 @@ def test_stall_fires_timeout_with_diagnostics(tmp_path):
         assert _wait_for(lambda: wd.fired)     # no further beats: stall
     diag = fired[0]
     assert diag["timeout_seconds"] == 0.15
-    assert diag["elapsed_seconds"] > 0.15
+    # elapsed is rounded to 3 decimals in the diagnostics, so a fire at
+    # exactly the timeout boundary can tie it — >= is the honest bound.
+    assert diag["elapsed_seconds"] >= 0.15
     assert diag["last_progress"] == {"k": 7, "diff": 0.5}
     # Diagnostics file lands next to the heartbeat for the post-mortem.
     stalled = json.loads(open(hb + ".stalled.json").read())
