@@ -427,6 +427,18 @@ def _warm_serve_buckets(problem, dtype, max_batch: int, requests: int,
     return ladder
 
 
+def _serve_p99_exemplar(svc):
+    from poisson_tpu.serve import p99_exemplar
+
+    return p99_exemplar(svc.outcomes())
+
+
+def _serve_slowest(svc, n: int = 3):
+    from poisson_tpu.serve import slowest_requests
+
+    return slowest_requests(svc.outcomes(), n)
+
+
 def _serve_openloop_bench(problem, requests: int, rate: float, devices,
                           platform: str, downgraded: bool = False) -> int:
     """Open-loop service mode: Poisson arrivals at ``rate`` requests/sec
@@ -497,7 +509,7 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
                 time.sleep(min(wait, 0.005))
         svc.drain()               # publish the serve.* gauges
         makespan = time.perf_counter() - t0
-        return svc.stats(), makespan
+        return svc.stats(), makespan, svc
 
     with obs.span("bench.serve_warmup", fence=False, requests=requests):
         t0 = time.time()
@@ -508,10 +520,10 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
 
     with obs.span("bench.serve_openloop", fence=False, mode="drain",
                   requests=requests):
-        drain_stats, drain_span = run(SCHED_DRAIN)
+        drain_stats, drain_span, _ = run(SCHED_DRAIN)
     with obs.span("bench.serve_openloop", fence=False, mode="continuous",
                   requests=requests):
-        cont_stats, cont_span = run(SCHED_CONTINUOUS)
+        cont_stats, cont_span, cont_svc = run(SCHED_CONTINUOUS)
 
     sustained = cont_stats["completed"] / cont_span if cont_span else 0.0
     drain_sustained = (drain_stats["completed"] / drain_span
@@ -546,6 +558,12 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
                 "serve.refill.idle_lane_steps"),
             "continuous_beats_drain": bool(
                 sustained >= drain_sustained and p99 <= drain_p99),
+            # Flight-recorder attribution (continuous arm): the p99 is
+            # traceable to the request that paid it, and the slowest
+            # requests carry their latency decompositions. regress.py
+            # ignores these keys — they never enter the cohort key.
+            "p99_exemplar": _serve_p99_exemplar(cont_svc),
+            "slowest_requests": _serve_slowest(cont_svc),
             "warmed_buckets": warmed,
             "warmup_seconds": round(warm_seconds, 2),
             "dtype": "float32",
@@ -659,6 +677,13 @@ def _serve_bench(problem, requests: int, devices, platform: str,
             "shed_rate": round(stats["shed_rate"], 4),
             "p50_seconds": round(lat["p50"], 4),
             "p95_seconds": round(lat["p95"], 4),
+            # The flight recorder's satellite fix: a p99 with no way to
+            # find the offending requests is a dead end — the exemplar
+            # trace id and the top-3 slowest requests' decompositions
+            # make it diagnosable. regress.py ignores these keys (they
+            # are not part of the cohort key; pinned by tests).
+            "p99_exemplar": _serve_p99_exemplar(svc),
+            "slowest_requests": _serve_slowest(svc),
             "throughput_rps": round(stats["completed"] / wall, 2),
             "wall_seconds": round(wall, 4),
             "first_run_seconds": round(first_run, 2),

@@ -110,7 +110,14 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
 def record_from_result(result: dict, source: str,
                        fallback_hint: bool = False) -> Optional[dict]:
     """A bench result line ({"metric": …, "value": …, "detail": …}) as a
-    sentinel record; None when it is not a bench metric."""
+    sentinel record; None when it is not a bench metric.
+
+    Detail keys are picked explicitly, never copied wholesale: the
+    flight-recorder attribution serve-mode records carry
+    (``p99_exemplar``, ``slowest_requests`` — per-request trace ids and
+    latency decompositions) is diagnosis payload, not experiment
+    identity, so it must never leak into :func:`cohort_key` and split
+    cohorts (pinned by ``tests/test_flight.py``)."""
     if not isinstance(result, dict) or result.get("metric") not in _METRICS:
         return None
     det = result.get("detail") or {}

@@ -153,6 +153,21 @@ def _row_from(step: str, e: dict) -> list[str] | None:
 # -- telemetry forensics mode (poisson_tpu.obs trace directories) -------
 
 
+def _flatten_event(rec: dict) -> dict:
+    """Normalize a JSONL event record across schema generations (the
+    stdlib twin of ``obs.trace.normalize_event`` — this module must not
+    import the framework): v2 lines carry caller fields under ``attrs``,
+    merged flat here where they don't collide with the envelope; v1
+    lines pass through unchanged."""
+    attrs = rec.get("attrs")
+    if not isinstance(attrs, dict):
+        return rec
+    out = {k: v for k, v in attrs.items() if k not in rec}
+    out.update(rec)
+    out["attrs"] = attrs
+    return out
+
+
 def _read_jsonl(path: pathlib.Path) -> list[dict]:
     records = []
     try:
@@ -164,7 +179,7 @@ def _read_jsonl(path: pathlib.Path) -> list[dict]:
         if not line:
             continue
         try:
-            records.append(json.loads(line))
+            records.append(_flatten_event(json.loads(line)))
         except ValueError:
             continue        # torn tail line of a killed process
     return records
@@ -352,6 +367,74 @@ def telemetry_report(tdir: pathlib.Path) -> int:
                   f"(p99 {e.get('p99_seconds')} s) vs drain "
                   f"{e.get('drain_solves_per_sec')} sv/s (p99 "
                   f"{e.get('drain_p99_seconds')} s) — {verdict}")
+
+    # Flight recorder (obs.flight): per-request causal traces and their
+    # latency decompositions — render the aggregate view plus ONE
+    # request's end-to-end timeline (the slowest, the request a p99
+    # post-mortem starts from).
+    def _fa(rec, key, default=None):
+        attrs = rec.get("attrs")
+        if isinstance(attrs, dict) and key in attrs:
+            return attrs[key]
+        return rec.get(key, default)
+
+    flight_outcomes = [e for e in events if e.get("kind") == "event"
+                       and e.get("name") == "flight.outcome"]
+    if flight_outcomes:
+        print("\n## Flight recorder\n")
+        admits = sum(1 for e in events if e.get("name") == "flight.admit")
+        print(f"{admits} request trace(s), {len(flight_outcomes)} "
+              f"typed outcome leaf(s).")
+        ranked = sorted(flight_outcomes,
+                        key=lambda e: -(_fa(e, "wall_s", 0.0) or 0.0))
+        print("\n| request | outcome | wall s | queue | compute "
+              "| lane wait | backoff | overhead | trace id |")
+        print("|---|---|---|---|---|---|---|---|---|")
+        for e in ranked[:5]:
+            print(f"| {_fa(e, 'request_id')} "
+                  f"| {_fa(e, 'kind')}:{_fa(e, 'type')} "
+                  f"| {_fmt(_fa(e, 'wall_s'))} "
+                  f"| {_fmt(_fa(e, 'queue_s'))} "
+                  f"| {_fmt(_fa(e, 'compute_s'))} "
+                  f"| {_fmt(_fa(e, 'lane_wait_s'))} "
+                  f"| {_fmt(_fa(e, 'backoff_s'))} "
+                  f"| {_fmt(_fa(e, 'overhead_s'))} "
+                  f"| {_fa(e, 'trace_id')} |")
+        slowest_tid = _fa(ranked[0], "trace_id")
+        trace_evs = [e for e in events
+                     if str(e.get("name", "")).startswith("flight.")
+                     and _fa(e, "trace_id") == slowest_tid]
+        trace_evs.sort(key=lambda r: (
+            _fa(r, "t", _fa(r, "t0", 0.0)) or 0.0,
+            r.get("at_unix", 0.0)))
+        print(f"\nSlowest request timeline (trace {slowest_tid} — "
+              f"`python -m poisson_tpu trace "
+              f"{_fa(ranked[0], 'request_id')} --telemetry {tdir}`):\n")
+        t_admit = next((_fa(e, "t", 0.0) for e in trace_evs
+                        if e.get("name") == "flight.admit"), 0.0)
+        for e in trace_evs:
+            t = _fa(e, "t", _fa(e, "t0", 0.0)) or 0.0
+            if e.get("name") == "flight.admit":
+                print(f"- +{max(0.0, t - t_admit):.4f}s admit")
+            elif e.get("name") == "flight.span":
+                print(f"- +{max(0.0, t - t_admit):.4f}s "
+                      f"{_fa(e, 'span')} [{_fa(e, 'seconds', 0.0)}s]")
+            elif e.get("name") == "flight.point":
+                print(f"- +{max(0.0, t - t_admit):.4f}s · "
+                      f"{_fa(e, 'point')}")
+            elif e.get("name") == "flight.outcome":
+                print(f"- +{max(0.0, t - t_admit):.4f}s outcome "
+                      f"{_fa(e, 'kind')}:{_fa(e, 'type')}")
+        slo_counters = {k: v for k, v in counters.items()
+                        if k.startswith("serve.slo.")}
+        if slo_counters:
+            good = slo_counters.get("serve.slo.good", 0)
+            bad = slo_counters.get("serve.slo.bad", 0)
+            total = good + bad
+            if total:
+                print(f"\nSLO: {good}/{total} good "
+                      f"({good / total:.1%} of outcomes met the "
+                      "objective).")
 
     # Incidents: everything that is not routine liveness.
     incidents = [e for e in events if e.get("kind") == "event" and e.get(

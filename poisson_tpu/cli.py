@@ -22,8 +22,14 @@ plus the solve-service fire drill and its chaos campaign
 chaos testing"):
 
     python -m poisson_tpu serve M N --requests R [--deadline S]
-                              [--fault-poison K] [--prom-out PATH] [--json]
+                              [--fault-poison K] [--prom-out PATH]
+                              [--trace-dir DIR] [--json]
     python -m poisson_tpu chaos --all --seed 0 [--out-dir DIR] [--json]
+
+plus the flight-recorder viewer (``obs.flight`` — one request's causal
+timeline and latency decomposition, read from the JSONL event log):
+
+    python -m poisson_tpu trace REQUEST_ID --telemetry DIR [--json]
 
 Both entry points honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the JAX
 persistent compilation cache, ``utils.compile_cache``): traced programs
@@ -841,6 +847,11 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--prom-out", metavar="PATH", default=None,
                    help="write a Prometheus textfile snapshot here at "
                         "exit (serve.* counters included)")
+    p.add_argument("--trace-dir", metavar="DIR", default=None,
+                   help="write unified telemetry here — including the "
+                        "flight recorder's per-request causal traces "
+                        "(view one with `python -m poisson_tpu trace "
+                        "REQUEST_ID --telemetry DIR`)")
     p.add_argument("--json", action="store_true",
                    help="one JSON line instead of a table")
     return p
@@ -857,9 +868,10 @@ def _main_serve(argv) -> int:
     from poisson_tpu.utils.compile_cache import enable_from_env
 
     enable_from_env()
-    if args.metrics_out or args.prom_out:
+    if args.metrics_out or args.prom_out or args.trace_dir:
         obs.configure(metrics_path=args.metrics_out,
-                      prom_path=args.prom_out)
+                      prom_path=args.prom_out,
+                      trace_dir=args.trace_dir)
     if args.dtype == "float64":
         import jax
 
@@ -924,6 +936,15 @@ def _main_serve(argv) -> int:
                             stats["latency_seconds"].items()},
         "breakers": stats["breakers"],
     }
+    # Flight-recorder attribution: the p99 is findable, not just a
+    # number — its exemplar trace id names the request that paid it,
+    # and the slowest requests ride with their latency decompositions.
+    from poisson_tpu.serve import p99_exemplar, slowest_requests
+
+    exemplar = p99_exemplar(outs)
+    if exemplar is not None:
+        record["p99_exemplar"] = exemplar
+    record["slowest_requests"] = slowest_requests(outs)
     obs.event("serve.report", **record)
     obs.finalize()
     if args.json:
@@ -946,7 +967,71 @@ def _main_serve(argv) -> int:
         kinds[key] = kinds.get(key, 0) + 1
     print("  taxonomy: " + ", ".join(f"{k}={v}"
                                      for k, v in sorted(kinds.items())))
+    if exemplar is not None:
+        print(f"  p99 exemplar: request {exemplar['request_id']} "
+              f"(trace {exemplar['trace_id']}, "
+              f"{exemplar['latency_seconds']} s)"
+              + (f" — inspect with `python -m poisson_tpu trace "
+                 f"{exemplar['request_id']} --telemetry "
+                 f"{args.trace_dir}`" if args.trace_dir else ""))
     return 0 if stats["lost"] == 0 else 1
+
+
+def build_trace_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m poisson_tpu trace",
+        description="Flight-recorder viewer (obs.flight): render one "
+                    "request's causal timeline — admit, queue wait, "
+                    "lane residency with chunk steps, backoff/retries, "
+                    "the typed outcome, and the latency decomposition — "
+                    "from a telemetry directory's JSONL event log.",
+    )
+    p.add_argument("request_id",
+                   help="request id to trace (the LAST matching trace "
+                        "when ids recycled across runs)")
+    p.add_argument("--telemetry", required=True, metavar="DIR",
+                   help="unified-telemetry directory (--trace-dir "
+                        "output; the chaos CLI's out-dir/trace)")
+    p.add_argument("--trace-id", default=None,
+                   help="disambiguate by exact trace id instead of "
+                        "request id")
+    p.add_argument("--json", action="store_true",
+                   help="emit the trace's raw records as JSON lines")
+    return p
+
+
+def _main_trace(argv) -> int:
+    args = build_trace_parser().parse_args(argv)
+    import os
+
+    from poisson_tpu.obs import flight
+    from poisson_tpu.obs.trace import load_events
+
+    if not os.path.isdir(args.telemetry):
+        print(f"no telemetry directory at {args.telemetry}",
+              file=sys.stderr)
+        return 1
+    events = load_events(args.telemetry)
+    tid, records = flight.find_trace(
+        events, request_id=args.request_id, trace_id=args.trace_id)
+    if tid is None:
+        print(f"no flight trace for "
+              f"{'trace id ' + args.trace_id if args.trace_id else 'request ' + args.request_id}"
+              f" in {args.telemetry}", file=sys.stderr)
+        return 1
+    if args.json:
+        for rec in records:
+            print(json.dumps(rec, default=str))
+    else:
+        print(flight.render_timeline(records))
+    # Both modes fail on a broken tree: --json exists for automation,
+    # which needs the incomplete-trace signal MORE than a human does.
+    problems = flight.validate_trace(records)
+    if problems:
+        print("INCOMPLETE TRACE: " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def build_chaos_parser() -> argparse.ArgumentParser:
@@ -970,7 +1055,8 @@ def build_chaos_parser() -> argparse.ArgumentParser:
                    help="list scenario names and exit")
     p.add_argument("--out-dir", metavar="DIR", default=None,
                    help="keep per-scenario metrics snapshots (JSON + "
-                        "Prometheus text) and the campaign report here")
+                        "Prometheus text), the campaign report, and the "
+                        "flight-recorder JSONL (trace/ subdir) here")
     p.add_argument("--json", action="store_true",
                    help="print the campaign report as JSON")
     return p
@@ -1004,8 +1090,43 @@ def _main_chaos(argv) -> int:
     # numerical environment so a scenario behaves identically under
     # pytest (x64 on) and from a bare CLI.
     jax.config.update("jax_enable_x64", True)
-    campaign = chaos.run_campaign(
-        args.scenarios or None, seed=args.seed, out_dir=args.out_dir)
+    # Flight-recorder acceptance rail: the campaign runs with the JSONL
+    # recorder on, and afterwards EVERY admitted request's causal trace
+    # is validated from the emitted file — one admit root, one typed
+    # outcome leaf, no orphan spans, decomposition summing to wall —
+    # not from any in-process state. Incomplete traces fail the run.
+    import os as _os
+    import tempfile as _tempfile
+
+    from poisson_tpu import obs
+    from poisson_tpu.obs import flight as _flight
+    from poisson_tpu.obs.trace import load_events as _load_events
+
+    tmp_ctx = None
+    if args.out_dir:
+        flight_dir = _os.path.join(args.out_dir, "trace")
+    else:
+        tmp_ctx = _tempfile.TemporaryDirectory(
+            prefix="poisson-chaos-flight-")
+        flight_dir = tmp_ctx.name
+    obs.configure(trace_dir=flight_dir)
+    try:
+        campaign = chaos.run_campaign(
+            args.scenarios or None, seed=args.seed, out_dir=args.out_dir)
+        obs.finalize()
+        flight_events = _load_events(flight_dir)
+    finally:
+        obs.shutdown()
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
+    flight_report = _flight.validate_events(flight_events)
+    admitted_total = sum(rep["invariant"]["admitted"]
+                         for rep in campaign["scenarios"])
+    flight_report["admitted"] = admitted_total
+    flight_report["ok"] = (flight_report["complete"]
+                           and flight_report["traces"] == admitted_total)
+    campaign["flight"] = flight_report
+    campaign["ok"] = campaign["ok"] and flight_report["ok"]
     if args.json:
         print(json.dumps(campaign))
         return 0 if campaign["ok"] else 1
@@ -1018,6 +1139,13 @@ def _main_chaos(argv) -> int:
         if failed:
             line += "  failed: " + ", ".join(failed)
         print(line)
+    fl = campaign["flight"]
+    fl_mark = "ok " if fl["ok"] else "FAIL"
+    fl_line = (f"{fl_mark} flight recorder: {fl['traces']} causal "
+               f"trace(s) for {fl['admitted']} admitted request(s)")
+    if fl["problems"]:
+        fl_line += f"  incomplete: {sorted(fl['problems'])}"
+    print(fl_line)
     verdict = "ok" if campaign["ok"] else "FAILED"
     print(f"chaos campaign {verdict}: {len(campaign['scenarios'])} "
           f"scenario(s), seed {campaign['seed']}")
@@ -1036,6 +1164,8 @@ def main(argv=None) -> int:
         return _main_serve(argv[1:])
     if argv and argv[0] == "chaos":
         return _main_chaos(argv[1:])
+    if argv and argv[0] == "trace":
+        return _main_trace(argv[1:])
     args = build_parser().parse_args(argv)
     # Reconcile the positional and flag grid forms: exactly one per axis.
     for axis in ("M", "N"):

@@ -25,7 +25,14 @@ stack (PhaseTimer dicts, watchdog heartbeat JSON, restart history inside
   counter/gauge registry as scrape-able text: a textfile snapshot at
   finalize (``POISSON_TPU_PROM_OUT``) and an opt-in live ``/metrics``
   endpoint (``POISSON_TPU_METRICS_PORT``) for long multi-solve
-  sessions.
+  sessions;
+- **flight recording** (:mod:`poisson_tpu.obs.flight`) — per-request
+  causal span trees for the solve service on the JSONL rails
+  (``trace_id``/``request_id`` attribution), latency decomposition on
+  every outcome (components summing to measured wall), and SLO
+  accounting (good/bad counters, a real latency histogram, multi-window
+  burn rates) — rendered by ``python -m poisson_tpu trace`` and the
+  forensics report.
 
 Usage (the CLI wires this from ``--trace-dir``/``--metrics-out``/
 ``--stream-every``; ``bench.py`` from ``POISSON_TPU_TRACE_DIR`` etc.):

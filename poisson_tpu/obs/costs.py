@@ -115,6 +115,28 @@ def analytic_iteration_cost(M: int, N: int, dtype_bytes: int = 4,
     }
 
 
+def apportion_compute(span_seconds: float,
+                      member_iterations: dict) -> dict:
+    """Split one shared dispatch span's measured wall across its members
+    by iteration count — the flight recorder's compute attribution.
+
+    A fused batched dispatch (or lane chunk step) advances every member
+    inside ONE measured span; the per-iteration cost of the program is
+    the same for every lane (identical vmapped body — the quantity the
+    analytic model above prices), so a member's share of the span is
+    ``span_seconds × own_iterations / Σ iterations``. Members that
+    advanced zero iterations (frozen, done, evicted) get 0.0 — their
+    residency is lane-wait, not compute. The shares sum to
+    ``span_seconds`` exactly (up to float rounding), which is what lets
+    a request's latency decomposition sum to its measured wall.
+    """
+    total = sum(max(0, int(k)) for k in member_iterations.values())
+    if total <= 0:
+        return {mid: 0.0 for mid in member_iterations}
+    return {mid: span_seconds * max(0, int(k)) / total
+            for mid, k in member_iterations.items()}
+
+
 # -- compiled-executable introspection ----------------------------------
 
 

@@ -22,7 +22,12 @@ counter``, numeric gauges as ``gauge``; a gauge whose value is a dict of
 numeric quantiles (the solve service's ``serve.latency_seconds`` =
 ``{"p50": …, "p95": …, "p99": …}``) renders as a Prometheus *summary*
 with ``quantile`` labels — the native exposition of latency percentiles,
-so a scrape alerts on ``…{quantile="0.99"}`` directly. Other non-numeric
+so a scrape alerts on ``…{quantile="0.99"}`` directly. A gauge in the
+histogram shape (``{"le": {...cumulative bucket counts...}, "sum": …,
+"count": …}`` — the flight recorder's ``serve.slo.latency_seconds``)
+renders as a Prometheus *histogram*: ``_bucket{le="…"}`` samples plus
+``_sum``/``_count``, the distribution SLO burn-rate alerting is
+computed from. Other non-numeric
 gauges (strings, lists — legal in the JSON snapshot) are skipped with a
 ``# skipped`` comment because the exposition format has no place for
 them. :func:`parse_text` reads the format back — the round-trip contract
@@ -70,6 +75,20 @@ def _quantile_label(key: str) -> Optional[str]:
     return f"{q:g}"
 
 
+def _is_histogram_gauge(val) -> bool:
+    """The histogram gauge shape ``obs.flight.LatencyHistogram.snapshot``
+    emits: cumulative ``le`` counts plus ``sum``/``count``."""
+    return (isinstance(val, dict) and set(val) == {"le", "sum", "count"}
+            and isinstance(val.get("le"), dict) and val["le"]
+            and all(isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                    for v in val["le"].values()))
+
+
+def _bucket_sort_key(le: str) -> float:
+    return float("inf") if le == "+Inf" else float(le)
+
+
 def render(snapshot: Optional[dict] = None) -> str:
     """The registry (or a given :func:`metrics.snapshot`) as exposition
     text. Deterministic ordering (sorted names) so diffs are readable."""
@@ -80,6 +99,20 @@ def render(snapshot: Optional[dict] = None) -> str:
         for name in sorted(bucket):
             val = bucket[name]
             prom = metric_name(name)
+            if kind == "gauge" and _is_histogram_gauge(val):
+                # Latency histogram (serve.slo.latency_seconds): the
+                # native Prometheus histogram exposition — cumulative
+                # le-labeled buckets plus _sum/_count, so burn-rate
+                # alerts can re-threshold the distribution at scrape
+                # time instead of trusting pre-baked percentiles.
+                lines.append(f"# HELP {prom} poisson_tpu histogram {name}")
+                lines.append(f"# TYPE {prom} histogram")
+                for le in sorted(val["le"], key=_bucket_sort_key):
+                    lines.append(f'{prom}_bucket{{le="{le}"}} '
+                                 f"{_fmt_value(val['le'][le])}")
+                lines.append(f"{prom}_sum {_fmt_value(val['sum'])}")
+                lines.append(f"{prom}_count {_fmt_value(val['count'])}")
+                continue
             if (kind == "gauge" and isinstance(val, dict) and val
                     and all(isinstance(v, (int, float))
                             and not isinstance(v, bool)
@@ -132,7 +165,17 @@ def parse_text(text: str) -> dict:
             raise ValueError(f"unparseable exposition line: {line!r}")
         name, raw = parts
         base = name.partition("{")[0]
-        out[name] = {"type": types.get(base), "value": float(raw)}
+        mtype = types.get(base)
+        if mtype is None:
+            # Histogram samples carry the family name plus a suffix
+            # (_bucket/_sum/_count); resolve the type from the family's
+            # TYPE line so the round trip stays lossless.
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix):
+                    mtype = types.get(base[: -len(suffix)])
+                    if mtype is not None:
+                        break
+        out[name] = {"type": mtype, "value": float(raw)}
     return out
 
 

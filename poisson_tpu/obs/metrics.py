@@ -58,7 +58,16 @@ Naming convention (dotted, low cardinality):
   chunk boundary) / ``serve.refill.idle_lane_steps`` (Σ EMPTY lanes per
   chunk step — the fused width paid for open seats) /
   ``serve.refill.refill_denied_by_breaker`` (refill decisions refused
-  by an open cohort breaker).
+  by an open cohort breaker);
+- ``serve.slo.*`` — the flight recorder's SLO accounting
+  (``obs.flight.SLOTracker``, objectives declared in
+  ``serve.types.SLOPolicy``): ``serve.slo.good`` / ``serve.slo.bad``
+  count outcomes for/against the objective (good = a converged result
+  delivered within ``latency_objective_seconds``; sheds, typed errors,
+  partials, and slow successes are bad — they spend error budget);
+  ``serve.degraded.slo_driven`` counts load-level decisions where the
+  burn rate (not queue depth) chose the degradation rung
+  (``SLOPolicy.degrade_on_burn``).
 
 Gauge families (``obs.costs`` sets these; ``obs.export`` exposes both
 counters and numeric gauges in Prometheus text format):
@@ -78,7 +87,21 @@ counters and numeric gauges in Prometheus text format):
   a Prometheus summary with quantile labels;
 - ``serve.refill.active_lanes`` (occupancy after the latest chunk step)
   and ``serve.sustained_solves_per_sec`` / ``serve.drain_solves_per_sec``
-  (the open-loop A/B headline, ``bench.py --serve --arrival-rate``).
+  (the open-loop A/B headline, ``bench.py --serve --arrival-rate``);
+- the SLO surface (``obs.flight.SLOTracker``; all on the service
+  clock): ``serve.slo.latency_seconds`` is a REAL latency histogram —
+  a ``{"le": {bucket: cumulative_count}, "sum": …, "count": …}`` dict
+  that ``obs.export`` renders as a Prometheus *histogram*
+  (``…_bucket{le="…"}``/``…_sum``/``…_count``), so burn-rate alerting
+  re-thresholds the distribution at scrape time instead of trusting
+  pre-baked percentiles; ``serve.slo.budget_remaining`` is the fraction
+  of the cumulative error budget left (1.0 = untouched, negative = an
+  honest overdraft); ``serve.slo.burn_rate.{W}s`` is the trailing
+  W-second burn rate, one gauge per ``SLOPolicy.burn_windows`` entry
+  (burn 1.0 = spending budget exactly at the availability target;
+  multi-window alerting ANDs a short and a long window);
+  ``serve.slo.objective_seconds`` echoes the declared latency
+  objective so the exposition is self-describing.
 """
 
 from __future__ import annotations

@@ -15,10 +15,13 @@ model (``obs.costs``, agreement within ±25%), a Prometheus exposition
 round trip (``obs.export`` render → parse, live ``/metrics`` endpoint),
 and the regression sentinel (``benchmarks/regress.py``) on a synthetic
 history that must classify a platform fallback as such and flag a 2×
-slowdown. Steps 11–12 run LAST (each resets the metrics registry): the
-solve-service → chaos → exposition smoke, then the continuous-batching
+slowdown. Steps 11–13 run LAST (each resets the metrics registry): the
+solve-service → chaos → exposition smoke, the continuous-batching
 smoke — an open-loop refill drive, the refill-poison-splice race, and
-the ``serve.refill.*`` counters surviving exposition.
+the ``serve.refill.*`` counters surviving exposition — and the flight
+recorder: an open-loop run traced end to end from the JSONL (complete
+causal tree, decomposition summing to wall, timeline render) with the
+``serve_slo_*`` counters and real histogram buckets in the exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -104,11 +107,15 @@ def run_selfcheck(out_dir: str) -> int:
     if not {"selfcheck", "selfcheck.solve", "selfcheck.done"} <= names:
         return _fail(f"expected spans/events absent from trace: {names}")
 
-    # 3. Event log: every line parses, spans carry fenced durations.
+    # 3. Event log: every line parses, spans carry fenced durations
+    # (normalize_event folds the v2 attrs block flat — the same loader
+    # tolerance load_events applies to v1 and v2 lines alike).
+    from poisson_tpu.obs.trace import normalize_event
+
     span_ends = 0
     with open(rec.events_path) as f:
         for line in f:
-            recd = json.loads(line)
+            recd = normalize_event(json.loads(line))
             for key in ("kind", "name", "at_unix", "at_mono", "rank"):
                 if key not in recd:
                     return _fail(f"event record missing {key!r}: {recd}")
@@ -302,6 +309,61 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in refill_parsed:
             return _fail(f"exposition lost the {prom_name} counter")
 
+    # 13. Flight recorder + SLOs, end to end (runs LAST, clean
+    # registry): an open-loop continuous run with a mid-flight join →
+    # one request traced end to end FROM THE JSONL (complete causal
+    # tree) → its timeline renders → the live Prometheus exposition
+    # carries the serve_slo_* counters and real histogram buckets.
+    from poisson_tpu.obs import flight as obs_flight
+    from poisson_tpu.obs import trace as obs_trace
+    from poisson_tpu.serve.types import SLOPolicy
+
+    obs_metrics.reset()
+    vc13 = VirtualClock()
+    svc13 = SolveService(
+        ServicePolicy(scheduling=SCHED_CONTINUOUS, max_batch=4,
+                      refill_chunk=10,
+                      slo=SLOPolicy(latency_objective_seconds=5.0)),
+        clock=vc13, sleep=vc13.sleep, seed=0,
+        dispatch_fault=lambda reqs, att: vc13.advance(0.1),
+    )
+    svc13.submit(SolveRequest(request_id="traced", problem=problem))
+    svc13.pump()
+    svc13.pump()                   # "traced" is mid-flight
+    svc13.submit(SolveRequest(request_id="joiner", problem=problem,
+                              rhs_gate=1.1))
+    flight_outs = {o.request_id: o for o in svc13.drain()}
+    traced = flight_outs["traced"]
+    if not traced.trace_id or traced.decomposition is None:
+        return _fail(f"outcome carries no flight attribution: {traced}")
+    d = traced.decomposition
+    parts = (d["queue_s"] + d["compute_s"] + d["lane_wait_s"]
+             + d["backoff_s"] + d["overhead_s"])
+    if abs(parts - d["wall_s"]) > 1e-4:
+        return _fail(f"decomposition does not sum to wall: {d}")
+    flight_events = obs_trace.load_events(out_dir)
+    tid, trecs = obs_flight.find_trace(flight_events,
+                                       trace_id=traced.trace_id)
+    if tid is None:
+        return _fail(f"trace {traced.trace_id} absent from the JSONL")
+    trace_problems = obs_flight.validate_trace(trecs)
+    if trace_problems:
+        return _fail(f"incomplete causal trace: {trace_problems}")
+    timeline = obs_flight.render_timeline(trecs)
+    if "admit" not in timeline or "outcome" not in timeline:
+        return _fail(f"timeline render incomplete:\n{timeline}")
+    slo_parsed = export.parse_text(export.render())
+    if "poisson_tpu_serve_slo_good" not in slo_parsed:
+        return _fail("exposition lost the serve.slo.good counter")
+    bucket_keys = [k for k in slo_parsed
+                   if k.startswith(
+                       "poisson_tpu_serve_slo_latency_seconds_bucket")]
+    if not bucket_keys:
+        return _fail("exposition carries no SLO histogram buckets")
+    if slo_parsed[bucket_keys[0]]["type"] != "histogram":
+        return _fail(f"histogram family mistyped: "
+                     f"{slo_parsed[bucket_keys[0]]}")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -309,7 +371,9 @@ def run_selfcheck(out_dir: str) -> int:
           f"metrics, sentinel ok, chaos overload-shed ok "
           f"({report['invariant']['admitted']} admitted, 0 lost), "
           f"continuous batching ok ({int(splices)} splices, "
-          f"refill-poison-splice green) ({out_dir})")
+          f"refill-poison-splice green), flight recorder ok "
+          f"(trace {tid} complete, {len(bucket_keys)} histogram "
+          f"buckets) ({out_dir})")
     return 0
 
 

@@ -38,6 +38,16 @@ import threading
 import time
 from typing import Optional
 
+# JSONL event-log schema version. v1 (PR 2–6) laid caller fields flat
+# next to the reserved keys (at_unix/at_mono/rank/kind/name) — a caller
+# field that collided with a reserved key was silently dropped, and
+# there was no place for structured per-request attribution. v2 carries
+# every caller field under an ``attrs`` block (so ``trace_id``/
+# ``request_id`` flow through verbatim, collisions included) while the
+# reserved envelope stays flat; :func:`load_events` normalizes both
+# generations to one readable shape, so PR 2–6 artifacts keep loading.
+EVENTS_SCHEMA = 2
+
 
 def _device_fence() -> None:
     """Best-effort fence of outstanding device work (lazy jax import: a
@@ -242,19 +252,26 @@ class TraceRecorder:
 
     def _emit_jsonl(self, kind: str, name: str, fields: dict) -> None:
         rec = {
+            "schema": EVENTS_SCHEMA,
             "at_unix": time.time(),
             "at_mono": time.monotonic(),
             "rank": self.rank,
             "kind": kind,
             "name": name,
+            # v2: caller fields ride the attrs block verbatim — a field
+            # named "kind" or "rank" is preserved instead of silently
+            # dropped, and request attribution (trace_id/request_id)
+            # has a structured home.
+            "attrs": dict(fields),
         }
-        for key, val in fields.items():
-            if key not in rec:
-                rec[key] = val
         with self._lock:
             if self._closed:
                 return
-            self._recent.append(rec)
+            # The ring holds the normalized shape (attrs also merged
+            # flat where they don't collide) so existing readers of
+            # recent_events() — watchdog stall diagnostics — keep
+            # working unchanged.
+            self._recent.append(normalize_event(rec))
             path = self.events_path
             if path is None:
                 return
@@ -270,10 +287,28 @@ class TraceRecorder:
 # -- multihost/multi-rank merging --------------------------------------
 
 
+def normalize_event(rec: dict) -> dict:
+    """One JSONL record in the canonical readable shape, whichever
+    schema generation wrote it: v2's ``attrs`` are merged flat where
+    they do not collide with the reserved envelope (so v1-era readers
+    like ``summarize_session`` keep one access path) AND kept intact
+    under ``attrs`` (so a caller field that shadowed a reserved key —
+    the v1 silent-drop bug — is still reachable). v1 records pass
+    through unchanged."""
+    attrs = rec.get("attrs")
+    if not isinstance(attrs, dict):
+        return rec
+    out = {k: v for k, v in attrs.items() if k not in rec}
+    out.update(rec)
+    out["attrs"] = attrs
+    return out
+
+
 def load_events(trace_dir: str) -> list[dict]:
-    """Every rank's JSONL records under ``trace_dir``, merged and sorted
-    by wall time (the cross-host ordering; per-rank order is preserved
-    for ties)."""
+    """Every rank's JSONL records under ``trace_dir``, normalized
+    (:func:`normalize_event` — v1 and v2 lines both load), merged and
+    sorted by wall time (the cross-host ordering; per-rank order is
+    preserved for ties)."""
     records = []
     for fname in sorted(os.listdir(trace_dir)):
         if not (fname.startswith("events-rank") and fname.endswith(".jsonl")):
@@ -284,7 +319,7 @@ def load_events(trace_dir: str) -> list[dict]:
                 if not line:
                     continue
                 try:
-                    records.append(json.loads(line))
+                    records.append(normalize_event(json.loads(line)))
                 except ValueError:
                     continue        # torn tail line of a killed process
     records.sort(key=lambda r: r.get("at_unix", 0.0))
@@ -295,20 +330,37 @@ def merge_trace_dir(trace_dir: str,
                     out_path: Optional[str] = None) -> dict:
     """Merge every rank's Chrome trace under ``trace_dir`` into one
     trace document (ranks stay separate rows via their ``pid``).
-    Writes ``trace-merged.trace.json`` when ``out_path`` is not given."""
+
+    Every event kind is preserved — complete spans (``ph: X``), instant
+    events (``ph: i``), and anything a future recorder adds — with the
+    per-kind tally recorded in ``otherData.event_kinds`` so a merge
+    that lost a kind is visible, not silent. A rank file that fails to
+    parse (torn write of a killed process) is skipped audibly via
+    ``otherData.skipped`` instead of sinking the whole merge. Writes
+    ``trace-merged.trace.json`` when ``out_path`` is not given."""
     merged: list[dict] = []
     ranks = []
+    skipped = []
     for fname in sorted(os.listdir(trace_dir)):
         if not (fname.startswith("trace-rank")
                 and fname.endswith(".trace.json")):
             continue
-        with open(os.path.join(trace_dir, fname)) as f:
-            doc = json.load(f)
+        try:
+            with open(os.path.join(trace_dir, fname)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            skipped.append({"file": fname, "error": str(e)[:200]})
+            continue
         merged.extend(doc.get("traceEvents", []))
         ranks.append(doc.get("otherData", {}).get("rank"))
     merged.sort(key=lambda e: e.get("ts", 0.0))
+    kinds: dict = {}
+    for ev in merged:
+        ph = str(ev.get("ph", "?"))
+        kinds[ph] = kinds.get(ph, 0) + 1
     doc = {"traceEvents": merged, "displayTimeUnit": "ms",
-           "otherData": {"ranks": ranks, "tool": "poisson_tpu.obs"}}
+           "otherData": {"ranks": ranks, "tool": "poisson_tpu.obs",
+                         "event_kinds": kinds, "skipped": skipped}}
     if out_path is None:
         out_path = os.path.join(trace_dir, "trace-merged.trace.json")
     with open(out_path, "w") as f:

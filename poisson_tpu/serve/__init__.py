@@ -24,7 +24,11 @@ shed) == 0``; no request is ever silently lost.
 
 from poisson_tpu.serve.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from poisson_tpu.serve.deadline import Deadline
-from poisson_tpu.serve.service import SolveService
+from poisson_tpu.serve.service import (
+    SolveService,
+    p99_exemplar,
+    slowest_requests,
+)
 from poisson_tpu.serve.types import (
     ERROR_DIVERGENCE,
     ERROR_INTERNAL,
@@ -42,6 +46,7 @@ from poisson_tpu.serve.types import (
     SHED_BREAKER_OPEN,
     SHED_DEADLINE_EXPIRED,
     SHED_QUEUE_FULL,
+    SLOPolicy,
     SolveRequest,
     TransientDispatchError,
 )
@@ -53,5 +58,6 @@ __all__ = [
     "OUTCOME_RESULT", "OUTCOME_SHED", "RetryPolicy", "SCHED_CONTINUOUS",
     "SCHED_DRAIN", "ServicePolicy",
     "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED", "SHED_QUEUE_FULL",
-    "SolveRequest", "SolveService", "TransientDispatchError",
+    "SLOPolicy", "SolveRequest", "SolveService",
+    "TransientDispatchError", "p99_exemplar", "slowest_requests",
 ]

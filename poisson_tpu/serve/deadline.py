@@ -22,7 +22,7 @@ class Deadline:
     after construction. ``seconds=None`` never expires (the explicit
     no-deadline object, so call sites need no None-guards)."""
 
-    __slots__ = ("seconds", "_clock", "_expires_at")
+    __slots__ = ("seconds", "_clock", "_t0", "_expires_at")
 
     def __init__(self, seconds: Optional[float],
                  clock: Callable[[], float] = time.monotonic):
@@ -30,7 +30,8 @@ class Deadline:
             raise ValueError(f"deadline seconds must be >= 0, got {seconds}")
         self.seconds = seconds
         self._clock = clock
-        self._expires_at = None if seconds is None else clock() + seconds
+        self._t0 = clock()
+        self._expires_at = None if seconds is None else self._t0 + seconds
 
     @classmethod
     def never(cls) -> "Deadline":
@@ -46,6 +47,13 @@ class Deadline:
     def expired(self) -> bool:
         rem = self.remaining()
         return rem is not None and rem <= 0
+
+    def elapsed(self) -> float:
+        """Seconds since the budget started — what a deadline-flagged
+        outcome actually spent, for the flight recorder's timeline
+        (``remaining()`` alone cannot say how much of a blown budget the
+        request consumed before its verdict)."""
+        return max(0.0, self._clock() - self._t0)
 
     def __repr__(self) -> str:  # readable in chaos reports / diagnostics
         if self._expires_at is None:

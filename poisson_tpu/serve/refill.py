@@ -51,9 +51,21 @@ class LaneTable:
                  chunk: int):
         self.cohort = cohort
         self.problem = problem
-        self.batch = LaneBatch(problem, bucket, dtype=dtype, chunk=chunk)
+        self.batch = LaneBatch(
+            problem, bucket, dtype=dtype, chunk=chunk,
+            # Chunk-boundary hook (solvers.lanes): each boundary is a
+            # timeline event, so a wedged lane program's last boundary
+            # is on disk for forensics. Host-side only — flag-off lane
+            # programs are byte-identical.
+            on_boundary=lambda acc: obs.event(
+                "serve.refill.chunk_boundary", cohort=cohort, **acc),
+        )
         self.entries: List[Optional[object]] = [None] * self.batch.bucket
         self.dtype_name = self.batch.dtype_name
+        # Per-lane iteration high-water marks: advance_marks() turns two
+        # consecutive boundaries into per-member iteration deltas — the
+        # flight recorder's compute-apportionment input.
+        self._k_mark: List[int] = [0] * self.batch.bucket
 
     @property
     def bucket(self) -> int:
@@ -92,6 +104,7 @@ class LaneTable:
         """EMPTY → ACTIVE for ``entry``; returns the lane."""
         lane = self.batch.splice(entry.request.request_id, rhs_gate)
         self.entries[lane] = entry
+        self._k_mark[lane] = 0      # a spliced member starts at k = 0
         obs.inc("serve.refill.splices")
         obs.event("serve.refill.splice", cohort=self.cohort, lane=lane,
                   request_id=str(entry.request.request_id),
@@ -114,6 +127,20 @@ class LaneTable:
             v["state"] = (LANE_EMPTY if v["member_id"] is None
                           else LANE_ACTIVE)
         return views
+
+    def advance_marks(self, views: List[dict]) -> dict:
+        """Iteration deltas since the previous boundary, per occupied
+        lane (``{lane: dk}``), advancing the marks — what one chunk
+        step actually bought each member, the flight recorder's
+        compute-apportionment input (``obs.costs.apportion_compute``)."""
+        deltas = {}
+        for v in views:
+            lane = v["lane"]
+            if self.entries[lane] is None:
+                continue
+            deltas[lane] = max(0, v["k"] - self._k_mark[lane])
+            self._k_mark[lane] = v["k"]
+        return deltas
 
     def retire(self, lane: int) -> Tuple[object, LaneResult]:
         """ACTIVE → RETIRING → EMPTY: pull the lane's entry and its

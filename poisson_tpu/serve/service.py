@@ -25,7 +25,14 @@ request level (the shape Orca, PAPERS.md, gives a serving stack):
   audible as ``serve.degraded.*`` counters;
 - **the ledger invariant** — every admitted request terminates with
   exactly one typed outcome; ``stats()['lost']`` is computed, asserted
-  by the chaos campaign, and exported with the ``serve.*`` counters.
+  by the chaos campaign, and exported with the ``serve.*`` counters;
+- **flight recording** (``obs.flight``) — every admitted request gets a
+  trace id and a causal span tree (admit → queue_wait → lane_resident
+  with chunk-step points → backoff_wait/retry → one typed outcome leaf)
+  on the JSONL rails, a latency decomposition on its Outcome
+  (components summing to the measured wall), and SLO accounting
+  (``serve.slo.*`` counters/histogram/burn rates) that the degradation
+  ladder can consult (``SLOPolicy.degrade_on_burn``).
 
 The service is deliberately single-threaded and clock/sleep-injectable:
 the dispatch loop IS the unit under chaos test, and determinism (seeded
@@ -43,6 +50,16 @@ from typing import Callable, List, Optional, Sequence, Union
 import numpy as np
 
 from poisson_tpu import obs
+from poisson_tpu.obs.costs import apportion_compute
+from poisson_tpu.obs.flight import (
+    POINT_DEADLINE,
+    POINT_RETRY,
+    SPAN_BACKOFF,
+    SPAN_QUEUE,
+    SPAN_RESIDENT,
+    FlightRecorder,
+    SLOTracker,
+)
 from poisson_tpu.serve.breaker import CircuitBreaker
 from poisson_tpu.serve.deadline import Deadline
 from poisson_tpu.serve.types import (
@@ -93,6 +110,33 @@ def _percentile(sorted_vals: Sequence[float], q: float) -> float:
     return float(sorted_vals[idx])
 
 
+def p99_exemplar(outcomes) -> Optional[dict]:
+    """The outcome whose latency IS the nearest-rank p99 — the exemplar
+    trace id bench records and the fire drill attach, so a p99 number
+    is always traceable to the request that paid it (the flight
+    recorder's `trace` CLI renders it end to end)."""
+    if not outcomes:
+        return None
+    ranked = sorted(outcomes, key=lambda o: o.latency_seconds)
+    idx = max(0, min(len(ranked) - 1,
+                     -(-99 * len(ranked) // 100) - 1))   # stdlib ceil
+    o = ranked[idx]
+    return {"request_id": o.request_id, "trace_id": o.trace_id,
+            "latency_seconds": round(o.latency_seconds, 4)}
+
+
+def slowest_requests(outcomes, n: int = 3) -> list:
+    """Top-N slowest outcomes with their latency decompositions — the
+    bench/fire-drill ``detail`` block that makes a bad percentile
+    diagnosable (where did THIS request's latency go) instead of just
+    reportable."""
+    ranked = sorted(outcomes, key=lambda o: -o.latency_seconds)[:n]
+    return [{"request_id": o.request_id, "trace_id": o.trace_id,
+             "latency_seconds": round(o.latency_seconds, 4),
+             "kind": o.kind,
+             "decomposition": o.decomposition} for o in ranked]
+
+
 class SolveService:
     """Single-process solve service over the JAX solver stack.
 
@@ -137,6 +181,14 @@ class SolveService:
         self._counts = {"admitted": 0, "completed": 0, "errors": 0,
                         "shed": 0}
         self._table = None   # continuous mode's live LaneTable (or None)
+        # Flight recorder + SLO tracker (obs.flight): per-request causal
+        # span trees on the service clock, latency decomposition on
+        # every outcome, and the serve.slo.* accounting the degradation
+        # ladder may consult. Host-side bookkeeping only — deterministic
+        # under VirtualClock, no-op on the JSONL rails when telemetry is
+        # unconfigured.
+        self._flight = FlightRecorder(clock=clock)
+        self._slo = SLOTracker(self.policy.slo, clock=clock)
 
     # -- admission -----------------------------------------------------
 
@@ -154,6 +206,7 @@ class SolveService:
             )
         self._counts["admitted"] += 1
         obs.inc("serve.admitted")
+        self._flight.admit(request.request_id)   # causal trace root
         now = self._clock()
         deadline = (Deadline(request.deadline_seconds, clock=self._clock)
                     if request.deadline_seconds is not None else None)
@@ -164,6 +217,7 @@ class SolveService:
                               "admission queue at capacity "
                               f"({self.policy.capacity})")
         self._pending_ids.add(request.request_id)
+        self._flight.begin(request.request_id, SPAN_QUEUE)
         self._queue.append(entry)
         obs.gauge("serve.queue_depth", len(self._queue) + len(self._delayed))
         return None
@@ -205,7 +259,9 @@ class SolveService:
         self._pump_delayed()
         if not self._queue and self._delayed:
             self._delayed.sort(key=lambda e: e.not_before)
-            self._queue.append(self._delayed.pop(0))
+            head = self._delayed.pop(0)
+            self._end_backoff(head)
+            self._queue.append(head)
         return True
 
     def _pop_live_head(self) -> Optional[_Entry]:
@@ -214,6 +270,9 @@ class SolveService:
         head = self._queue.popleft()
         if head.deadline is not None and head.deadline.expired():
             obs.inc("serve.deadline.expired_in_queue")
+            self._flight.point(head.request.request_id, POINT_DEADLINE,
+                               where="queued",
+                               elapsed=round(head.deadline.elapsed(), 4))
             self._shed(head, SHED_DEADLINE_EXPIRED,
                        "deadline expired while queued")
             return None
@@ -248,7 +307,16 @@ class SolveService:
         if ready:
             self._delayed = [e for e in self._delayed
                              if e.not_before > now]
+            for e in ready:
+                self._end_backoff(e)
             self._queue.extend(ready)
+
+    def _end_backoff(self, entry: _Entry) -> None:
+        """Backoff over, back in line: the flight-recorder transition
+        every promotion path (timer pump OR forced) must take."""
+        rid = entry.request.request_id
+        self._flight.end(rid, SPAN_BACKOFF)
+        self._flight.begin(rid, SPAN_QUEUE, attempt=entry.attempts + 1)
 
     # -- batching ------------------------------------------------------
 
@@ -299,13 +367,23 @@ class SolveService:
     def _load_level(self, depth: int) -> int:
         frac = depth / self.policy.capacity
         d = self.policy.degradation
-        if frac >= d.downshift_precision_at:
-            return 3
-        if frac >= d.cap_iterations_at:
-            return 2
+        level = 0
         if frac >= d.shrink_padding_at:
-            return 1
-        return 0
+            level = 1
+        if frac >= d.cap_iterations_at:
+            level = 2
+        if frac >= d.downshift_precision_at:
+            level = 3
+        # SLO-driven rung (opt-in, SLOPolicy.degrade_on_burn): when the
+        # multi-window burn rate asks for a deeper downshift than queue
+        # depth does, the burn wins — the ladder responds to the
+        # objective being missed, not only to backlog. Audible as its
+        # own counter so an SLO-triggered downshift is attributable.
+        slo_level = self._slo.degrade_level()
+        if slo_level > level:
+            obs.inc("serve.degraded.slo_driven")
+            level = slo_level
+        return level
 
     # -- continuous batching (lane table + refill state machine) -------
 
@@ -449,6 +527,10 @@ class SolveService:
                 continue
             if entry.deadline is not None and entry.deadline.expired():
                 obs.inc("serve.deadline.expired_in_queue")
+                self._flight.point(entry.request.request_id,
+                                   POINT_DEADLINE, where="refill_queue",
+                                   elapsed=round(
+                                       entry.deadline.elapsed(), 4))
                 self._shed(entry, SHED_DEADLINE_EXPIRED,
                            "deadline expired while queued")
                 continue
@@ -475,7 +557,12 @@ class SolveService:
             if (level >= 3
                     and (entry.request.dtype or "auto") == "float64"):
                 obs.inc("serve.degraded.precision")
-            table.splice(entry, entry.request.rhs_gate)
+            lane = table.splice(entry, entry.request.rhs_gate)
+            rid = entry.request.request_id
+            self._flight.end(rid, SPAN_QUEUE)
+            self._flight.begin(rid, SPAN_RESIDENT, mode="lane",
+                               bucket=table.bucket, lane=lane,
+                               level=level)
         while kept:        # skipped entries return in arrival order
             self._queue.appendleft(kept.pop())
 
@@ -488,6 +575,8 @@ class SolveService:
         table = self._table
         breaker = self._breaker(table.cohort)
         occupants = table.occupants()
+        did = self._flight.next_dispatch_id()
+        t_step = self._clock()
         try:
             with obs.span("serve.refill.step", fence=False,
                           cohort=table.cohort, active=len(occupants)):
@@ -499,6 +588,8 @@ class SolveService:
                 table.step()
         except TransientDispatchError as e:
             breaker.record_failure()
+            self._flight_dispatch_failed(occupants, did, t_step,
+                                         type(e).__name__)
             evicted = table.evict_all()
             self._table = None
             co_ids = {en.request.request_id for en in evicted}
@@ -508,21 +599,36 @@ class SolveService:
             return
         except Exception as e:  # internal: surfaced, never retried
             breaker.record_failure()
+            self._flight_dispatch_failed(occupants, did, t_step,
+                                         type(e).__name__)
             evicted = table.evict_all()
             self._table = None
             for en in evicted:
                 self._error(en, ERROR_INTERNAL,
                             f"{type(e).__name__}: {e}")
             return
-        self._retire_boundary(table, breaker)
+        # Flight: one chunk step advanced every resident lane inside one
+        # measured span; divide its wall by the iterations it bought
+        # (apportion_compute) and stamp a chunk_step point per member.
+        views = table.lane_view()
+        secs = max(0.0, self._clock() - t_step)
+        deltas = table.advance_marks(views)
+        by_member = {table.entries[lane].request.request_id: dk
+                     for lane, dk in deltas.items()}
+        shares = apportion_compute(secs, by_member)
+        for lane, dk in deltas.items():
+            rid = table.entries[lane].request.request_id
+            self._flight.add_step(rid, secs, dk, shares[rid], did,
+                                  k=views[lane]["k"])
+        self._retire_boundary(table, breaker, views)
 
-    def _retire_boundary(self, table, breaker) -> None:
+    def _retire_boundary(self, table, breaker, views) -> None:
         from poisson_tpu.solvers.pcg import FLAG_DEADLINE, FLAG_NONE
 
         co_ids = table.occupant_ids()
         any_failed = False
         any_clean = False
-        for view in table.lane_view():
+        for view in views:
             if view["member_id"] is None:
                 continue
             entry = table.entries[view["lane"]]
@@ -533,6 +639,14 @@ class SolveService:
             if not (view["done"] or view["k"] >= cap or deadline_hit):
                 continue               # still ACTIVE: rides the next chunk
             entry, result = table.retire(view["lane"])
+            if deadline_hit:
+                self._flight.point(entry.request.request_id,
+                                   POINT_DEADLINE, where="lane",
+                                   elapsed=round(
+                                       entry.deadline.elapsed(), 4))
+            self._flight.end(entry.request.request_id, SPAN_RESIDENT,
+                             iterations=result.iterations,
+                             flag=result.flag_name)
             flag = result.flag
             if deadline_hit and flag == FLAG_NONE:
                 # A healthy lane overtaken by its budget: partial result,
@@ -582,6 +696,18 @@ class SolveService:
         obs.inc("serve.dispatches")
         obs.inc("serve.batch_members", len(batch))
         cohort = self._cohort(head.request)
+        # Flight: members leave the queue and become resident in one
+        # shared dispatch — the dispatch id is the causal parent linking
+        # every member's residency span and chunk-step points.
+        did = self._flight.next_dispatch_id()
+        solo = len(batch) == 1 and self._solo(head)
+        mode = "solo" if solo else "drain"
+        for entry in batch:
+            rid = entry.request.request_id
+            self._flight.end(rid, SPAN_QUEUE)
+            self._flight.begin(rid, SPAN_RESIDENT, dispatch=did,
+                               mode=mode, batch=len(batch), level=level)
+        t_disp = self._clock()
         try:
             with obs.span("serve.dispatch", fence=False, cohort=cohort,
                           batch=len(batch), level=level):
@@ -589,14 +715,16 @@ class SolveService:
                     self._dispatch_fault([e.request for e in batch],
                                          {e.request.request_id: e.attempts
                                           for e in batch})
-                if len(batch) == 1 and self._solo(head):
+                if solo:
                     member_failed = self._dispatch_solo(head, problem,
-                                                        dtype)
+                                                        dtype, did, t_disp)
                 else:
                     member_failed = self._dispatch_batched(
-                        batch, problem, dtype, exact_bucket)
+                        batch, problem, dtype, exact_bucket, did, t_disp)
         except TransientDispatchError as e:
             breaker.record_failure()
+            self._flight_dispatch_failed(batch, did, t_disp,
+                                         type(e).__name__)
             co_ids = {entry.request.request_id for entry in batch}
             for entry in batch:
                 self._retry_or_fail(entry, ERROR_TRANSIENT, str(e),
@@ -604,6 +732,8 @@ class SolveService:
             return
         except Exception as e:  # internal: surfaced, never retried
             breaker.record_failure()
+            self._flight_dispatch_failed(batch, did, t_disp,
+                                         type(e).__name__)
             for entry in batch:
                 self._error(entry, ERROR_INTERNAL,
                             f"{type(e).__name__}: {e}")
@@ -613,8 +743,20 @@ class SolveService:
         else:
             breaker.record_success()
 
+    def _flight_dispatch_failed(self, batch: List[_Entry], did: str,
+                                t_disp: float, error: str) -> None:
+        """A whole dispatch died: the members' residency still happened
+        (and is accounted), but no iterations can be attributed — the
+        time they paid is lane-wait on a program that produced nothing."""
+        secs = max(0.0, self._clock() - t_disp)
+        for entry in batch:
+            rid = entry.request.request_id
+            self._flight.add_step(rid, secs, 0, 0.0, did)
+            self._flight.end(rid, SPAN_RESIDENT, error=error)
+
     def _dispatch_batched(self, batch: List[_Entry], problem, dtype,
-                          exact_bucket: bool) -> bool:
+                          exact_bucket: bool, did: str,
+                          t_disp: float) -> bool:
         from poisson_tpu.solvers.batched import solve_batched
 
         result = solve_batched(
@@ -628,6 +770,19 @@ class SolveService:
         iters = np.asarray(result.iterations)
         flags = np.asarray(result.flag)
         diffs = np.asarray(result.diff)
+        # Flight: one fused dispatch advanced every member; its measured
+        # wall divides among them by iteration count (the measured
+        # per-iteration cost of the shared program — obs.costs).
+        secs = max(0.0, self._clock() - t_disp)
+        shares = apportion_compute(
+            secs, {e.request.request_id: int(iters[i])
+                   for i, e in enumerate(batch)})
+        for i, entry in enumerate(batch):
+            rid = entry.request.request_id
+            self._flight.add_step(rid, secs, int(iters[i]),
+                                  shares[rid], did, k=int(iters[i]))
+            self._flight.end(rid, SPAN_RESIDENT,
+                             iterations=int(iters[i]))
         any_failed = False
         for i, entry in enumerate(batch):
             assert result.origin[i] == entry.request.request_id
@@ -639,7 +794,8 @@ class SolveService:
             any_failed = any_failed or failed
         return any_failed
 
-    def _dispatch_solo(self, entry: _Entry, problem, dtype) -> bool:
+    def _dispatch_solo(self, entry: _Entry, problem, dtype, did: str,
+                       t_disp: float) -> bool:
         from poisson_tpu.solvers.checkpoint import pcg_solve_chunked
         from poisson_tpu.solvers.resilient import (
             DivergenceError,
@@ -652,6 +808,7 @@ class SolveService:
         # same way (the batched path uses rhs_gates for the shared-setup
         # win; a solo dispatch has nothing to share).
         solo_problem = problem.with_(f_val=problem.f_val * req.rhs_gate)
+        rid = req.request_id
         if entry.escalate and self.policy.retry.escalate_divergence:
             obs.inc("serve.escalations")
             try:
@@ -660,6 +817,10 @@ class SolveService:
                     deadline=entry.deadline, on_chunk=req.on_chunk,
                 )
             except DivergenceError as e:
+                secs = max(0.0, self._clock() - t_disp)
+                self._flight.add_step(rid, secs, 0, 0.0, did)
+                self._flight.end(rid, SPAN_RESIDENT,
+                                 error="DivergenceError")
                 self._error(entry, ERROR_DIVERGENCE, str(e))
                 return True
         else:
@@ -667,6 +828,13 @@ class SolveService:
                 solo_problem, chunk=chunk, dtype=dtype,
                 deadline=entry.deadline, on_chunk=req.on_chunk,
             )
+        # Flight: a solo dispatch's whole wall is this member's compute
+        # (it shares the program with nobody).
+        secs = max(0.0, self._clock() - t_disp)
+        iters = int(result.iterations)
+        self._flight.add_step(rid, secs, iters, secs if iters else 0.0,
+                              did, k=iters)
+        self._flight.end(rid, SPAN_RESIDENT, iterations=iters)
         return self._classify_member(
             entry, int(result.flag), int(result.iterations),
             float(np.max(np.asarray(result.diff))),
@@ -746,6 +914,12 @@ class SolveService:
         obs.event("serve.retry", request_id=str(entry.request.request_id),
                   attempt=entry.attempts, delay=round(delay, 4),
                   error=error_type, escalate=entry.escalate)
+        rid = entry.request.request_id
+        self._flight.point(rid, POINT_RETRY, attempt=entry.attempts,
+                           error=error_type, delay=round(delay, 4),
+                           escalate=entry.escalate)
+        self._flight.begin(rid, SPAN_BACKOFF, attempt=entry.attempts,
+                           delay=round(delay, 4))
         self._delayed.append(entry)
 
     def _backoff_delay(self, attempt: int) -> float:
@@ -769,6 +943,16 @@ class SolveService:
     def _latency(self, entry: _Entry) -> float:
         return max(0.0, self._clock() - entry.admitted_at)
 
+    def _close_flight(self, entry: _Entry, kind: str, type_: str,
+                      latency: float, attempts: int,
+                      good: bool) -> dict:
+        """Close the request's causal trace (one typed outcome leaf, any
+        open span folded into its accumulator) and score the SLO."""
+        fo = self._flight.outcome(entry.request.request_id, kind=kind,
+                                  type_=type_, attempts=attempts)
+        self._slo.record(latency, good)
+        return fo
+
     def _complete(self, entry: _Entry, flag: str, converged: bool,
                   partial: bool, iterations: int, restarts: int,
                   diff: float) -> Outcome:
@@ -778,12 +962,20 @@ class SolveService:
             obs.inc("serve.completed.partial")
         if restarts:
             obs.inc("serve.completed.recovered")
+        latency = self._latency(entry)
+        # SLO-good: a converged result inside the latency objective.
+        # Partial results and slow successes spend error budget.
+        good = (converged and latency
+                <= self.policy.slo.latency_objective_seconds)
+        fo = self._close_flight(entry, OUTCOME_RESULT, flag, latency,
+                                entry.attempts + 1, good)
         return self._record(Outcome(
             request_id=entry.request.request_id, kind=OUTCOME_RESULT,
             flag=flag, converged=converged, partial=partial,
             iterations=iterations, restarts=restarts,
             attempts=entry.attempts + 1,
-            latency_seconds=self._latency(entry), diff=diff,
+            latency_seconds=latency, diff=diff,
+            trace_id=fo["trace_id"], decomposition=fo["decomposition"],
         ))
 
     def _error(self, entry: _Entry, error_type: str, message: str
@@ -793,11 +985,15 @@ class SolveService:
         obs.inc(f"serve.errors.{error_type}")
         obs.event("serve.error", request_id=str(entry.request.request_id),
                   error=error_type, message=message[:200])
+        latency = self._latency(entry)
+        fo = self._close_flight(entry, OUTCOME_ERROR, error_type,
+                                latency, max(1, entry.attempts), False)
         return self._record(Outcome(
             request_id=entry.request.request_id, kind=OUTCOME_ERROR,
             error_type=error_type, message=message,
             attempts=max(1, entry.attempts),
-            latency_seconds=self._latency(entry),
+            latency_seconds=latency,
+            trace_id=fo["trace_id"], decomposition=fo["decomposition"],
         ))
 
     def _shed(self, entry: _Entry, reason: str, message: str) -> Outcome:
@@ -806,11 +1002,15 @@ class SolveService:
         obs.inc(f"serve.shed.{reason}")
         obs.event("serve.shed", request_id=str(entry.request.request_id),
                   reason=reason)
+        latency = self._latency(entry)
+        fo = self._close_flight(entry, OUTCOME_SHED, reason, latency,
+                                entry.attempts, False)
         return self._record(Outcome(
             request_id=entry.request.request_id, kind=OUTCOME_SHED,
             shed_reason=reason, message=message,
             attempts=entry.attempts,
-            latency_seconds=self._latency(entry),
+            latency_seconds=latency,
+            trace_id=fo["trace_id"], decomposition=fo["decomposition"],
         ))
 
     # -- accounting ----------------------------------------------------

@@ -94,6 +94,13 @@ class Outcome:
     shed_reason: str = ""         # queue_full | breaker_open | deadline_expired
     message: str = ""
     diff: Optional[float] = None  # final ‖Δw‖ (result outcomes)
+    # Flight-recorder attribution (obs.flight): the request's causal
+    # trace id (joins the JSONL span tree / `python -m poisson_tpu
+    # trace`) and its latency decomposition — wall_s = queue_s +
+    # compute_s + lane_wait_s + backoff_s + overhead_s on the service
+    # clock, components summing to the measured wall.
+    trace_id: str = ""
+    decomposition: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -156,6 +163,36 @@ class DegradationPolicy:
     downshift_precision_at: float = 0.9
 
 
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """Declared service-level objectives, scored per outcome by the
+    flight recorder's :class:`~poisson_tpu.obs.flight.SLOTracker`.
+
+    An outcome is **good** iff it is a converged result delivered within
+    ``latency_objective_seconds``; everything else — sheds, typed
+    errors, partial results, and slow successes — spends error budget
+    (budget = ``1 − availability_target``). The tracker publishes
+    ``serve.slo.{good,bad}`` counters, the real latency histogram
+    (``serve.slo.latency_seconds`` — Prometheus histogram exposition),
+    ``serve.slo.budget_remaining``, and one burn-rate gauge per entry in
+    ``burn_windows`` (seconds on the service clock; two windows is the
+    classic short-says-now / long-says-not-a-blip pairing).
+
+    ``degrade_on_burn`` lets the degradation ladder consult the burn
+    rate: rung *i+1* engages when EVERY window burns at or above
+    ``burn_degrade_thresholds[i]`` (multi-window rule), making
+    downshifts SLO-driven rather than only queue-depth-driven. Off by
+    default: burn-driven downshifts change scheduling decisions, so the
+    operator opts in with the thresholds they mean.
+    """
+
+    latency_objective_seconds: float = 2.0
+    availability_target: float = 0.999
+    burn_windows: tuple = (60.0, 600.0)
+    degrade_on_burn: bool = False
+    burn_degrade_thresholds: tuple = (2.0, 6.0, 14.0)
+
+
 # Scheduling modes (ServicePolicy.scheduling):
 SCHED_DRAIN = "drain"            # PR 5 batch-drain: dispatch, wait, repeat
 SCHED_CONTINUOUS = "continuous"  # lane table + refill state machine
@@ -191,3 +228,4 @@ class ServicePolicy:
     retry: RetryPolicy = RetryPolicy()
     breaker: BreakerPolicy = BreakerPolicy()
     degradation: DegradationPolicy = DegradationPolicy()
+    slo: SLOPolicy = SLOPolicy()

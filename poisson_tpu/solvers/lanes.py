@@ -168,11 +168,18 @@ class LaneBatch:
     """
 
     def __init__(self, problem: Problem, bucket: int, *, dtype=None,
-                 scaled=None, chunk: int = 50):
+                 scaled=None, chunk: int = 50, on_boundary=None):
         if bucket < 1:
             raise ValueError(f"bucket must be >= 1, got {bucket}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # Chunk-boundary event hook (the flight recorder's seam): called
+        # host-side after each step() with the step accounting
+        # ({"step", "active", "idle", "chunk"}). Purely host-side — the
+        # traced/jitted programs are the same objects with or without a
+        # hook, so the flag-off path is byte-identical and golden
+        # iteration counts are structurally unchanged.
+        self.on_boundary = on_boundary
         self.problem = problem
         self.bucket = int(bucket)
         self.chunk = int(chunk)
@@ -264,6 +271,9 @@ class LaneBatch:
                                      self._aux, self.state)
             self.steps += 1
             self.idle_lane_steps += idle
+            if self.on_boundary is not None:
+                self.on_boundary({"step": self.steps, "active": active,
+                                  "idle": idle, "chunk": self.chunk})
         return {"active": active, "idle": idle}
 
     def lane_view(self) -> List[dict]:

@@ -70,6 +70,13 @@ def pytest_configure(config):
         "deterministic chaos scenarios, the no-lost-request invariant; "
         "CPU-fast; runs in tier-1, selectable with -m serve)",
     )
+    config.addinivalue_line(
+        "markers",
+        "flight: request flight-recorder suite (per-request causal "
+        "traces, latency decomposition summing to wall, SLO "
+        "accounting/burn rates/histogram exposition, the trace CLI; "
+        "CPU-fast; runs in tier-1, selectable with -m flight)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
