@@ -28,7 +28,17 @@ with both engines' p50/p99 and the drain arm's sustained rate in the
 detail (``continuous_beats_drain`` is the at-equal-p99 verdict), cohorted
 by ``arrival_rate`` + ``fault_load`` so rates are never cross-judged.
 
-Both modes honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the persistent JAX
+Fleet mode (``--serve R --workers W [--kill-worker-at T]
+[--arrival-rate L]``) runs the open-loop generator across a W-worker
+supervised fleet (``serve.fleet``) and reports sustained solves/sec
+under worker churn: ``--kill-worker-at T`` crashes a worker mid-run, the
+supervisor recovers its in-flight requests onto the survivors, and the
+run fails unless every admitted request completed with exactly one typed
+outcome. ``detail.workers`` + the churn fault mix join the regression
+sentinel's cohort key — a churned fleet number never judges a
+single-worker clean baseline.
+
+All modes honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the persistent JAX
 compilation cache; hits/misses are counted in the metrics snapshot).
 
 Every record carries performance-attribution provenance: a ``costs``
@@ -427,6 +437,49 @@ def _warm_serve_buckets(problem, dtype, max_batch: int, requests: int,
     return ladder
 
 
+def _poisson_schedule(requests: int, rate: float, seed: int = 0):
+    """A seeded open-loop arrival schedule: ``(t_arrival, request_id,
+    rhs_gate)`` tuples at Poisson rate ``rate``/sec — the same schedule
+    drives every arm/run that wants to be comparable."""
+    import random
+
+    rng = random.Random(seed)
+    schedule, t = [], 0.0
+    for i in range(requests):
+        t += rng.expovariate(rate)
+        schedule.append((t, i, 1.0 + rng.random()))
+    return schedule
+
+
+def _drive_open_loop(svc, schedule, problem, t0=None):
+    """The open-loop protocol shared by the A/B and fleet serve benches:
+    submit the schedule on the wall clock (arrivals never wait for the
+    service), pump between arrivals so they join in-flight work, idle in
+    small sleeps until the next arrival is due, then drain. Returns
+    ``(stats, makespan_seconds)``."""
+    from poisson_tpu.serve import SolveRequest
+
+    if t0 is None:
+        t0 = time.perf_counter()
+    i = 0
+    while True:
+        now = time.perf_counter() - t0
+        while i < len(schedule) and schedule[i][0] <= now:
+            _, rid, gate = schedule[i]
+            svc.submit(SolveRequest(request_id=rid, problem=problem,
+                                    rhs_gate=gate, dtype="float32"))
+            i += 1
+        if svc.pump():
+            continue
+        if i >= len(schedule):
+            break
+        wait = schedule[i][0] - (time.perf_counter() - t0)
+        if wait > 0:              # idle until the next arrival is due
+            time.sleep(min(wait, 0.005))
+    svc.drain()                   # publish the serve.* gauges
+    return svc.stats(), time.perf_counter() - t0
+
+
 def _serve_p99_exemplar(svc):
     from poisson_tpu.serve import p99_exemplar
 
@@ -453,8 +506,6 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
     makes "continuous refill beats batch-drain at equal p99" a
     regress.py-cohortable claim rather than an assertion.
     """
-    import random
-
     from poisson_tpu import obs
     from poisson_tpu.serve import (
         DegradationPolicy,
@@ -462,7 +513,6 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
         SCHED_CONTINUOUS,
         SCHED_DRAIN,
         ServicePolicy,
-        SolveRequest,
         SolveService,
     )
 
@@ -474,11 +524,7 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
     quiet = DegradationPolicy(shrink_padding_at=9.0,
                               cap_iterations_at=9.0,
                               downshift_precision_at=9.0)
-    rng = random.Random(0)
-    schedule, t = [], 0.0
-    for i in range(requests):
-        t += rng.expovariate(rate)
-        schedule.append((t, i, 1.0 + rng.random()))
+    schedule = _poisson_schedule(requests, rate)
 
     def make_policy(mode):
         return ServicePolicy(
@@ -491,25 +537,8 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
 
     def run(mode):
         svc = SolveService(make_policy(mode), seed=0)
-        t0 = time.perf_counter()
-        i = 0
-        while True:
-            now = time.perf_counter() - t0
-            while i < len(schedule) and schedule[i][0] <= now:
-                _, rid, gate = schedule[i]
-                svc.submit(SolveRequest(request_id=rid, problem=problem,
-                                        rhs_gate=gate, dtype="float32"))
-                i += 1
-            if svc.pump():
-                continue
-            if i >= len(schedule):
-                break
-            wait = schedule[i][0] - (time.perf_counter() - t0)
-            if wait > 0:          # idle until the next arrival is due
-                time.sleep(min(wait, 0.005))
-        svc.drain()               # publish the serve.* gauges
-        makespan = time.perf_counter() - t0
-        return svc.stats(), makespan, svc
+        stats, makespan = _drive_open_loop(svc, schedule, problem)
+        return stats, makespan, svc
 
     with obs.span("bench.serve_warmup", fence=False, requests=requests):
         t0 = time.time()
@@ -586,6 +615,141 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
     obs.finalize()
     print(json.dumps(record))
     return 0 if record["detail"]["lost"] == 0 else 1
+
+
+def _serve_fleet_bench(problem, requests: int, workers: int,
+                       kill_at, rate, devices, platform: str,
+                       downgraded: bool = False) -> int:
+    """Fleet mode (``--serve R --workers W [--kill-worker-at T]``):
+    sustained solves/sec under worker churn. An open-loop Poisson
+    arrival schedule drives the continuous engine across a W-worker
+    fleet (``serve.fleet``); ``--kill-worker-at T`` injects a worker
+    crash at T seconds — the supervisor quarantines it, recovers its
+    in-flight requests onto the survivors, and restarts it through
+    warm-up, all while the generator keeps submitting. The record is
+    the surviving fleet's sustained throughput, and the run FAILS
+    (exit 1) unless every admitted request completed with exactly one
+    typed outcome — churn must never cost a request its outcome.
+
+    ``detail.workers`` and the churn fault mix join the regression
+    sentinel's cohort key (``benchmarks/regress.py``): a W-worker
+    number never judges a single-worker baseline.
+    """
+    from poisson_tpu import obs
+    from poisson_tpu.obs import metrics as obs_metrics
+    from poisson_tpu.serve import (
+        DegradationPolicy,
+        FleetPolicy,
+        RetryPolicy,
+        SCHED_CONTINUOUS,
+        ServicePolicy,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import kill_worker_at as churn_fault
+
+    rate = rate or 50.0
+    max_batch = 4
+    refill_chunk = 50
+    quiet = DegradationPolicy(shrink_padding_at=9.0,
+                              cap_iterations_at=9.0,
+                              downshift_precision_at=9.0)
+    policy = ServicePolicy(
+        capacity=max(4 * requests, 16), max_batch=max_batch,
+        scheduling=SCHED_CONTINUOUS, refill_chunk=refill_chunk,
+        degradation=quiet,
+        retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                          backoff_cap=0.1),
+        fleet=FleetPolicy(workers=workers, quarantine_seconds=0.2,
+                          recovery_backoff=0.02),
+    )
+    schedule = _poisson_schedule(requests, rate)
+
+    with obs.span("bench.serve_warmup", fence=False, requests=requests):
+        t0 = time.time()
+        warmed = _warm_serve_buckets(problem, "float32", max_batch,
+                                     requests, refill_chunk=refill_chunk)
+        warm_seconds = time.time() - t0
+    obs.inc("time.compile_seconds", warm_seconds)
+
+    # The churn clock starts before service construction so a
+    # --kill-worker-at 0 fires on the very first dispatch.
+    t_bench = time.perf_counter()
+    worker_fault = None
+    if kill_at is not None:
+        worker_fault = churn_fault(
+            kill_at, lambda: time.perf_counter() - t_bench)
+    svc = SolveService(policy, seed=0, worker_fault=worker_fault)
+    with obs.span("bench.serve_fleet", fence=False, requests=requests,
+                  workers=workers):
+        stats, makespan = _drive_open_loop(svc, schedule, problem,
+                                           t0=t_bench)
+    outcomes = svc.outcomes()
+    # The acceptance property: every admitted request, exactly one
+    # typed outcome — no deadlock, no phantom lost, even under churn.
+    every_accounted = (stats["lost"] == 0 and stats["pending"] == 0
+                       and len(outcomes) == stats["admitted"])
+    sustained = stats["completed"] / makespan if makespan else 0.0
+    # A kill that never fired (the run finished before T) is a CLEAN
+    # experiment and must cohort as one — regress.py keys on
+    # fault_load, and clean-speed values in the churn cohort would
+    # poison its baseline.
+    kill_fired = (worker_fault is not None
+                  and worker_fault.state["kills"] > 0)
+    if kill_at is not None and not kill_fired:
+        print(f"bench: --kill-worker-at {kill_at:g} never fired "
+              f"(makespan {makespan:.3f}s); recording fault_load=clean",
+              file=sys.stderr)
+    fault_load = f"kill_worker@{kill_at:g}" if kill_fired else "clean"
+    record = {
+        "metric": "serve.sustained_solves_per_sec",
+        "value": round(sustained, 3),
+        "unit": "solves/sec",
+        "detail": {
+            "grid": [problem.M, problem.N],
+            "requests": requests,
+            "arrival_rate": rate,
+            "scheduling": "continuous",
+            "workers": workers,
+            "kill_worker_at": kill_at,
+            "kill_fired": kill_fired,
+            "completed": stats["completed"],
+            "errors": stats["errors"],
+            "shed": stats["shed"],
+            "lost": stats["lost"],
+            "every_request_accounted": every_accounted,
+            "p99_seconds": round(stats["latency_seconds"]["p99"], 4),
+            "p50_seconds": round(stats["latency_seconds"]["p50"], 4),
+            "makespan_seconds": round(makespan, 4),
+            "quarantines": obs_metrics.get("serve.fleet.quarantines"),
+            "restarts": obs_metrics.get("serve.fleet.restarts"),
+            "recovered_requests": obs_metrics.get(
+                "serve.fleet.recovered_requests"),
+            "sticky_hits": obs_metrics.get("serve.fleet.sticky_hits"),
+            "p99_exemplar": _serve_p99_exemplar(svc),
+            "slowest_requests": _serve_slowest(svc),
+            "warmed_buckets": warmed,
+            "warmup_seconds": round(warm_seconds, 2),
+            "dtype": "float32",
+            "backend": "xla_serve",
+            "devices": 1,
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "platform_fallback": downgraded,
+            # Cohort discriminators for benchmarks/regress.py: worker
+            # count and churn mix are experiment identity — a 4-worker
+            # churn number never judges a single-worker clean baseline.
+            "fault_load": fault_load,
+        },
+    }
+    obs.gauge("serve.sustained_solves_per_sec", record["value"])
+    obs.event("bench.serve_fleet", **{
+        k: v for k, v in record["detail"].items()
+        if k not in ("p99_exemplar", "slowest_requests",
+                     "warmed_buckets")},
+        sustained_solves_per_sec=record["value"])
+    obs.finalize()
+    print(json.dumps(record))
+    return 0 if every_accounted else 1
 
 
 def _serve_bench(problem, requests: int, devices, platform: str,
@@ -805,6 +969,41 @@ def main() -> int:
             print(f"--arrival-rate must be > 0, got {arrival_rate}",
                   file=sys.stderr)
             return 2
+    serve_workers = None
+    if "--workers" in argv:
+        i = argv.index("--workers")
+        try:
+            serve_workers = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python bench.py --serve R --workers W "
+                  "[--kill-worker-at T] [M N]", file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if serve_requests is None:
+            print("--workers is a --serve mode option", file=sys.stderr)
+            return 2
+        if serve_workers < 1:
+            print(f"--workers must be >= 1, got {serve_workers}",
+                  file=sys.stderr)
+            return 2
+    kill_worker_at = None
+    if "--kill-worker-at" in argv:
+        i = argv.index("--kill-worker-at")
+        try:
+            kill_worker_at = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python bench.py --serve R --workers W "
+                  "--kill-worker-at T [M N]", file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if serve_workers is None:
+            print("--kill-worker-at is a --serve --workers mode option",
+                  file=sys.stderr)
+            return 2
+        if kill_worker_at < 0:
+            print(f"--kill-worker-at must be >= 0, got {kill_worker_at}",
+                  file=sys.stderr)
+            return 2
     if batch is not None and serve_requests is not None:
         print("--batch and --serve are separate bench modes; pick one",
               file=sys.stderr)
@@ -854,6 +1053,11 @@ def main() -> int:
         return _batched_bench(problem, batch, devices, platform,
                               downgraded=downgraded)
     if serve_requests is not None:
+        if serve_workers is not None:
+            return _serve_fleet_bench(problem, serve_requests,
+                                      serve_workers, kill_worker_at,
+                                      arrival_rate, devices, platform,
+                                      downgraded=downgraded)
         if arrival_rate is not None:
             return _serve_openloop_bench(problem, serve_requests,
                                          arrival_rate, devices, platform,

@@ -36,7 +36,11 @@ and is never judged against its baseline. Open-loop records
 (``--serve R --arrival-rate L``: ``serve.sustained_solves_per_sec``,
 higher-is-better like MLUPS) additionally carry ``detail.arrival_rate``
 in the cohort key: sustained throughput at one offered load never
-judges another.
+judges another. Fleet records (``--serve R --workers W``) carry
+``detail.workers`` in the cohort key too: a W-worker fleet under churn
+is a different experiment from the single-worker service, and its
+sustained throughput is never compared against single-worker baselines
+(direction-pinned by tests/test_fleet.py).
 
 Stdlib only, no jax import: like the forensics renderer, a post-session
 gate must never risk initializing a backend.
@@ -81,6 +85,7 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                platform_fallback=False, failed=False,
                fault_load: Optional[str] = None,
                arrival_rate: Optional[float] = None,
+               workers: Optional[int] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -102,6 +107,10 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # sustained throughput and percentiles at one offered load are a
         # different experiment from another rate — cohort key too.
         "arrival_rate": arrival_rate,
+        # Fleet records (bench.py --serve --workers W): the worker
+        # count is experiment identity — multi-worker churn throughput
+        # never judges single-worker baselines. Cohort key too.
+        "workers": workers,
         "failed": bool(failed),
         "note": note,
     }
@@ -135,6 +144,7 @@ def record_from_result(result: dict, source: str,
         platform_fallback=fallback,
         fault_load=det.get("fault_load"),
         arrival_rate=det.get("arrival_rate"),
+        workers=det.get("workers"),
     )
 
 
@@ -223,14 +233,15 @@ def load_session(path) -> list[dict]:
 def cohort_key(rec: dict):
     """Records are only ever compared inside this key: same metric, same
     grid, same dtype, same platform/backend/device-count — and, for
-    service-mode records, the same injected fault load AND the same
-    open-loop arrival rate (fault-load runs are never judged against
-    clean baselines; throughput at one offered load is a different
-    experiment from another)."""
+    service-mode records, the same injected fault load, the same
+    open-loop arrival rate, AND the same fleet worker count (fault-load
+    runs are never judged against clean baselines; throughput at one
+    offered load is a different experiment from another; a W-worker
+    fleet never judges a single-worker baseline)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
             rec.get("devices"), rec.get("fault_load"),
-            rec.get("arrival_rate"))
+            rec.get("arrival_rate"), rec.get("workers"))
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

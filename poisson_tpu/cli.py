@@ -22,6 +22,8 @@ plus the solve-service fire drill and its chaos campaign
 chaos testing"):
 
     python -m poisson_tpu serve M N --requests R [--deadline S]
+                              [--workers W] [--journal PATH] [--recover]
+                              [--kill-worker-at T] [--kill-after K]
                               [--fault-poison K] [--prom-out PATH]
                               [--trace-dir DIR] [--json]
     python -m poisson_tpu chaos --all --seed 0 [--out-dir DIR] [--json]
@@ -836,12 +838,41 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--refill-chunk", type=int, default=25,
                    help="iterations per lane-table step in --continuous "
                         "mode (default 25)")
+    p.add_argument("--workers", type=int, default=1, metavar="W",
+                   help="solve-fleet workers pulling from the shared "
+                        "admission queue (serve.fleet; default 1 — the "
+                        "classic single-worker service). Each worker "
+                        "owns sticky bucket executables, its own "
+                        "breaker cohort, and a heartbeat watchdog")
+    p.add_argument("--journal", metavar="PATH", default=None,
+                   help="write-ahead request journal (serve.journal): "
+                        "every lifecycle transition is CRC-sealed and "
+                        "appended here, so a crashed run can be "
+                        "replayed with --recover")
+    p.add_argument("--recover", action="store_true",
+                   help="replay --journal before serving: requests "
+                        "that were queued or in flight when the "
+                        "previous process died are re-enqueued "
+                        "(recovered taint/backoff path) and drained to "
+                        "their one typed outcome (--requests 0 runs "
+                        "recovery alone)")
     p.add_argument("--seed", type=int, default=0,
                    help="backoff-jitter / load RNG seed (default 0)")
     p.add_argument("--fault-poison", type=int, default=0, metavar="K",
                    help="fault injection: mark the first K requests as "
                         "batch-killing poison (typed transient errors "
                         "after retry isolation)")
+    p.add_argument("--kill-worker-at", type=float, default=None,
+                   metavar="T",
+                   help="fault injection: kill the next dispatching "
+                        "worker once T seconds of serving have passed "
+                        "(quarantine + recovery + restart, "
+                        "serve.fleet.*)")
+    p.add_argument("--kill-after", type=int, default=None, metavar="K",
+                   help="fault injection: flush telemetry and die with "
+                        "exit 75 (no cleanup) once K outcomes exist — "
+                        "the crash half of the journal drill; restart "
+                        "with --recover against the same --journal")
     p.add_argument("--metrics-out", metavar="PATH", default=None,
                    help="write the counters/gauges snapshot here at exit")
     p.add_argument("--prom-out", metavar="PATH", default=None,
@@ -859,10 +890,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
 
 def _main_serve(argv) -> int:
     args = build_serve_parser().parse_args(argv)
-    if args.requests < 1:
-        raise SystemExit(f"--requests must be >= 1, got {args.requests}")
+    if args.requests < (0 if args.recover else 1):
+        raise SystemExit(f"--requests must be >= 1, got {args.requests} "
+                         "(0 is allowed with --recover: recovery-only)")
     if args.capacity < 1:
         raise SystemExit(f"--capacity must be >= 1, got {args.capacity}")
+    if args.workers < 1:
+        raise SystemExit(f"--workers must be >= 1, got {args.workers}")
+    if args.recover and not args.journal:
+        raise SystemExit("--recover needs --journal PATH to replay")
     honor_jax_platforms_env()
     from poisson_tpu import obs
     from poisson_tpu.utils.compile_cache import enable_from_env
@@ -885,7 +921,9 @@ def _main_serve(argv) -> int:
         OUTCOME_SHED,
         SCHED_CONTINUOUS,
         SCHED_DRAIN,
+        FleetPolicy,
         ServicePolicy,
+        SolveJournal,
         SolveRequest,
         SolveService,
     )
@@ -897,14 +935,35 @@ def _main_serve(argv) -> int:
         from poisson_tpu.testing.faults import poison_batch_fault
 
         fault = poison_batch_fault(set(range(args.fault_poison)))
-    svc = SolveService(
-        ServicePolicy(capacity=args.capacity, max_batch=args.max_batch,
-                      default_chunk=args.chunk or 50,
-                      scheduling=(SCHED_CONTINUOUS if args.continuous
-                                  else SCHED_DRAIN),
-                      refill_chunk=args.refill_chunk),
-        seed=args.seed, dispatch_fault=fault,
+    worker_fault = None
+    if args.kill_worker_at is not None:
+        from poisson_tpu.testing.faults import kill_worker_at
+
+        t_start = time.monotonic()
+        worker_fault = kill_worker_at(
+            args.kill_worker_at, lambda: time.monotonic() - t_start)
+    policy = ServicePolicy(
+        capacity=args.capacity, max_batch=args.max_batch,
+        default_chunk=args.chunk or 50,
+        scheduling=(SCHED_CONTINUOUS if args.continuous
+                    else SCHED_DRAIN),
+        refill_chunk=args.refill_chunk,
+        fleet=FleetPolicy(workers=args.workers),
     )
+    journal = (SolveJournal(args.journal) if args.journal else None)
+    if args.recover:
+        svc = SolveService.recover(journal, policy, seed=args.seed,
+                                   dispatch_fault=fault,
+                                   worker_fault=worker_fault)
+        rec_report = svc.recovery
+        print(f"serve: recovered {len(rec_report.pending)} pending "
+              f"request(s) from {args.journal} "
+              f"({len(rec_report.outcomes)} prior outcome(s), "
+              f"{rec_report.torn_records} torn record(s) skipped)",
+              file=sys.stderr)
+    else:
+        svc = SolveService(policy, seed=args.seed, dispatch_fault=fault,
+                           worker_fault=worker_fault, journal=journal)
     rng = _random.Random(args.seed)
     t0 = time.perf_counter()
     for i in range(args.requests):
@@ -914,6 +973,18 @@ def _main_serve(argv) -> int:
             dtype=args.dtype, deadline_seconds=args.deadline,
             chunk=args.chunk,
         ))
+    if args.kill_after is not None:
+        # The crash half of the journal drill: once K outcomes exist,
+        # flush telemetry (the metrics snapshot is the accounting
+        # evidence) and die like a preemption — exit 75, no cleanup,
+        # queue and lane-resident requests abandoned. The journal is
+        # what makes the abandonment recoverable.
+        import os as _os
+
+        while svc.pump():
+            if len(svc.outcomes()) >= args.kill_after:
+                obs.finalize()
+                _os._exit(75)
     svc.drain()
     wall = time.perf_counter() - t0
     outs = svc.outcomes()
@@ -922,20 +993,32 @@ def _main_serve(argv) -> int:
                     if o.kind == OUTCOME_RESULT and o.converged)
     partial = sum(1 for o in outs
                   if o.kind == OUTCOME_RESULT and o.partial)
+    from poisson_tpu.obs import metrics as _metrics
+
     record = {
         "M": problem.M, "N": problem.N, "requests": args.requests,
         "scheduling": svc.policy.scheduling,
+        "workers": args.workers,
         "wall_seconds": round(wall, 4),
         "throughput_rps": round(stats["completed"] / wall, 2) if wall
         else None,
         "completed": stats["completed"], "converged": converged,
         "partial": partial, "errors": stats["errors"],
         "shed": stats["shed"], "lost": stats["lost"],
+        "recovered": stats["recovered"],
         "shed_rate": round(stats["shed_rate"], 4),
         "latency_seconds": {k: round(v, 4) for k, v in
                             stats["latency_seconds"].items()},
         "breakers": stats["breakers"],
     }
+    if args.workers > 1 or args.kill_worker_at is not None:
+        record["fleet"] = {
+            "workers": {str(k): v for k, v in stats["workers"].items()},
+            "quarantines": _metrics.get("serve.fleet.quarantines"),
+            "restarts": _metrics.get("serve.fleet.restarts"),
+            "recovered_requests": _metrics.get(
+                "serve.fleet.recovered_requests"),
+        }
     # Flight-recorder attribution: the p99 is findable, not just a
     # number — its exemplar trace id names the request that paid it,
     # and the slowest requests ride with their latency decompositions.
@@ -955,7 +1038,9 @@ def _main_serve(argv) -> int:
           f"in {wall:.2f} s ({record['throughput_rps']} completed/s)")
     print(f"  outcomes: {stats['completed']} results ({converged} "
           f"converged, {partial} partial) | {stats['errors']} typed "
-          f"errors | {stats['shed']} shed | lost {stats['lost']}")
+          f"errors | {stats['shed']} shed | lost {stats['lost']}"
+          + (f" | recovered {stats['recovered']}"
+             if stats["recovered"] else ""))
     print(f"  latency p50/p95/p99: {lat['p50']}/{lat['p95']}/{lat['p99']} "
           f"s | shed rate {record['shed_rate']:.1%}")
     kinds = {}

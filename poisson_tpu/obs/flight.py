@@ -76,6 +76,8 @@ SPAN_BACKOFF = "backoff_wait"
 POINT_RETRY = "retry"
 POINT_CHUNK = "chunk_step"
 POINT_DEADLINE = "deadline"
+POINT_RECOVERED = "recovered"      # re-enqueued off a dead worker/journal
+POINT_QUARANTINE = "quarantine"    # the worker serving this request fell
 
 _ROOT_SPAN_ID = 0
 
@@ -150,6 +152,25 @@ class FlightRecorder:
         obs.event("flight.admit", trace_id=trace_id,
                   request_id=str(request_id), t=tr.t_admit)
         return trace_id
+
+    def adopt(self, request_id, trace_id: str, t_admit: float,
+              span_base: int = 1000) -> None:
+        """Continue an EXISTING trace in a new recorder — the journal
+        recovery path (``serve.journal``): the crashed process emitted
+        the admit root and any completed spans; the recovering process
+        adopts the same trace id so the request's causal tree still has
+        exactly one root and one outcome leaf across the crash boundary.
+        ``span_base`` offsets this incarnation's span ids past the dead
+        process's sequence (1000 per recovery generation — a trace would
+        need a thousand lifecycle spans per life to collide, two orders
+        of magnitude past the deepest retry ladder the policy can
+        express); ``t_admit`` is the original admission time on the
+        service clock, so the final decomposition's wall covers the
+        crash gap (it lands in ``overhead_s`` — honest: nobody worked on
+        the request while the process was dead)."""
+        tr = _Trace(trace_id, request_id, t_admit)
+        tr.span_seq = span_base
+        self._traces[request_id] = tr
 
     def next_dispatch_id(self) -> str:
         """A shared-dispatch id: the causal parent linking every member
@@ -599,7 +620,7 @@ def render_timeline(records: List[dict]) -> str:
         elif name == "flight.span":
             extra = []
             for key in ("bucket", "lane", "dispatch", "mode", "batch",
-                        "error", "iterations", "flag"):
+                        "worker", "error", "iterations", "flag"):
                 val = _field(rec, key)
                 if val is not None:
                     extra.append(f"{key}={val}")
@@ -610,7 +631,8 @@ def render_timeline(records: List[dict]) -> str:
         elif name == "flight.point":
             extra = []
             for key in ("dispatch_id", "k", "dk", "attempt", "error",
-                        "lane", "compute_share"):
+                        "lane", "compute_share", "worker", "reason",
+                        "generation"):
                 val = _field(rec, key)
                 if val is not None:
                     extra.append(f"{key}={val}")

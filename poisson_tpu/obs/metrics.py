@@ -59,6 +59,34 @@ Naming convention (dotted, low cardinality):
   chunk step — the fused width paid for open seats) /
   ``serve.refill.refill_denied_by_breaker`` (refill decisions refused
   by an open cohort breaker);
+- ``serve.fleet.*`` — the durable solve fleet (``serve.fleet``,
+  ``ServicePolicy.fleet``): ``serve.fleet.quarantines`` (workers pulled
+  from scheduling after a crash/hang/stall verdict) /
+  ``serve.fleet.restarts`` (quarantined workers returned through
+  warm-up; ``serve.fleet.warmup_solves``/``.warmup_failures`` count the
+  sticky-bucket recompiles) / ``serve.fleet.worker_deaths`` (restart
+  budget exhausted — the worker never schedules again) /
+  ``serve.fleet.hangs`` (stall verdicts from the worker heartbeat
+  watchdog, landing next to ``watchdog.stalls``) /
+  ``serve.fleet.recovered_requests`` (in-flight requests pulled off a
+  fallen worker and re-dispatched to survivors with mutual taint) /
+  ``serve.fleet.sticky_{hits,misses}`` (routing that found/missed a
+  worker already holding the queue head's bucket executable);
+- ``serve.journal.*`` — the crash-safe write-ahead journal
+  (``serve.journal``): ``serve.journal.records`` (CRC-sealed lifecycle
+  transitions appended) / ``serve.journal.write_errors`` (appends the
+  disk refused — durability degraded, audibly) /
+  ``serve.journal.replays`` (recovery replays run) /
+  ``serve.journal.torn_records`` (torn-tail or CRC-failing records
+  skipped audibly during replay — never trusted, never fatal);
+  ``serve.recovered`` counts requests re-enqueued from a replay — NOT
+  re-counted as ``serve.admitted`` (the crashed process already counted
+  the admission), which is what closes the ledger invariant across a
+  kill/replay boundary when per-process snapshots merge;
+- ``serve.dedup.hits`` — idempotent submissions deduplicated against
+  the ledger (``ServicePolicy.dedup``): a client retry or replayed
+  submit whose ``request_id`` was already seen returns the original
+  outcome instead of double-admitting;
 - ``serve.slo.*`` — the flight recorder's SLO accounting
   (``obs.flight.SLOTracker``, objectives declared in
   ``serve.types.SLOPolicy``): ``serve.slo.good`` / ``serve.slo.bad``
@@ -88,6 +116,10 @@ counters and numeric gauges in Prometheus text format):
 - ``serve.refill.active_lanes`` (occupancy after the latest chunk step)
   and ``serve.sustained_solves_per_sec`` / ``serve.drain_solves_per_sec``
   (the open-loop A/B headline, ``bench.py --serve --arrival-rate``);
+- ``serve.fleet.workers`` (configured pool size) and
+  ``serve.fleet.live_workers`` (workers currently RUNNING — refreshed
+  on every quarantine/restart/death, so a shrinking fleet is visible
+  at scrape time);
 - the SLO surface (``obs.flight.SLOTracker``; all on the service
   clock): ``serve.slo.latency_seconds`` is a REAL latency histogram —
   a ``{"le": {bucket: cumulative_count}, "sum": …, "count": …}`` dict

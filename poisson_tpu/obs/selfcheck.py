@@ -15,13 +15,17 @@ model (``obs.costs``, agreement within ±25%), a Prometheus exposition
 round trip (``obs.export`` render → parse, live ``/metrics`` endpoint),
 and the regression sentinel (``benchmarks/regress.py``) on a synthetic
 history that must classify a platform fallback as such and flag a 2×
-slowdown. Steps 11–13 run LAST (each resets the metrics registry): the
+slowdown. Steps 11–14 run LAST (each resets the metrics registry): the
 solve-service → chaos → exposition smoke, the continuous-batching
 smoke — an open-loop refill drive, the refill-poison-splice race, and
-the ``serve.refill.*`` counters surviving exposition — and the flight
+the ``serve.refill.*`` counters surviving exposition — the flight
 recorder: an open-loop run traced end to end from the JSONL (complete
 causal tree, decomposition summing to wall, timeline render) with the
-``serve_slo_*`` counters and real histogram buckets in the exposition.
+``serve_slo_*`` counters and real histogram buckets in the exposition —
+and the durable solve fleet: a kill-one-worker drill (quarantine →
+recovery → restart) whose write-ahead journal replays back to the same
+ledger, with the ``serve_fleet_*``/``serve_journal_*`` counters
+surviving exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -364,6 +368,58 @@ def run_selfcheck(out_dir: str) -> int:
         return _fail(f"histogram family mistyped: "
                      f"{slo_parsed[bucket_keys[0]]}")
 
+    # 14. Durable solve fleet (runs LAST, clean registry): a two-worker
+    # fleet with a journal takes a worker kill mid-dispatch — the
+    # supervisor quarantines it, recovers the in-flight requests onto
+    # the survivor, restarts it through warm-up — then the journal
+    # replays back to the same ledger and the Prometheus exposition
+    # carries the serve_fleet_* counters.
+    from poisson_tpu.serve import FleetPolicy, SolveJournal, replay_journal
+    from poisson_tpu.testing.faults import worker_kill_fault
+
+    obs_metrics.reset()
+    vc14 = VirtualClock()
+    journal_path = os.path.join(out_dir, "serve.journal")
+    journal = SolveJournal(journal_path, clock=vc14)
+    svc14 = SolveService(
+        ServicePolicy(
+            capacity=16, max_batch=4,
+            fleet=FleetPolicy(workers=2, quarantine_seconds=0.02,
+                              recovery_backoff=0.02),
+        ),
+        clock=vc14, sleep=vc14.sleep, seed=0, journal=journal,
+        worker_fault=worker_kill_fault({0}),
+    )
+    for i in range(4):
+        svc14.submit(SolveRequest(request_id=f"fleet-{i}",
+                                  problem=problem, rhs_gate=1.0 + i / 10))
+    fleet_outs = svc14.drain()
+    journal.close()
+    fleet_stats = svc14.stats()
+    if fleet_stats["lost"] != 0 or len(fleet_outs) != 4:
+        return _fail(f"fleet drill lost requests: {fleet_stats}")
+    if not all(o.converged for o in fleet_outs):
+        return _fail("fleet drill: recovered requests did not converge")
+    quarantines = obs_metrics.get("serve.fleet.quarantines")
+    recovered = obs_metrics.get("serve.fleet.recovered_requests")
+    if quarantines < 1 or recovered < 1:
+        return _fail(f"fleet counters missed the kill: "
+                     f"quarantines={quarantines}, recovered={recovered}")
+    fleet_replay = replay_journal(journal_path)
+    if (len(fleet_replay.outcomes) != 4 or fleet_replay.pending
+            or fleet_replay.duplicate_outcomes):
+        return _fail(
+            f"journal replay disagrees with the ledger: "
+            f"{len(fleet_replay.outcomes)} outcomes, "
+            f"{len(fleet_replay.pending)} pending, "
+            f"dupes {fleet_replay.duplicate_outcomes}")
+    fleet_parsed = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_serve_fleet_quarantines",
+                      "poisson_tpu_serve_fleet_recovered_requests",
+                      "poisson_tpu_serve_journal_records"):
+        if prom_name not in fleet_parsed:
+            return _fail(f"exposition lost the {prom_name} counter")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -373,7 +429,9 @@ def run_selfcheck(out_dir: str) -> int:
           f"continuous batching ok ({int(splices)} splices, "
           f"refill-poison-splice green), flight recorder ok "
           f"(trace {tid} complete, {len(bucket_keys)} histogram "
-          f"buckets) ({out_dir})")
+          f"buckets), solve fleet ok ({int(quarantines)} quarantine, "
+          f"{int(recovered)} recovered, journal replay agrees) "
+          f"({out_dir})")
     return 0
 
 

@@ -175,6 +175,37 @@ class Watchdog:
     def fired(self) -> bool:
         return self._fired
 
+    def check(self) -> Optional[dict]:
+        """Synchronous stall check for cooperative supervisors with
+        injected clocks (``serve.fleet``): no monitor thread is armed —
+        the supervisor itself asks "has this worker beaten within the
+        timeout?" after every step. Fires at most once per watchdog
+        instance (like the monitor), writing the same diagnostics file
+        and counting the same ``watchdog.stalls``; returns the
+        diagnostics dict when the stall verdict lands, None otherwise.
+        A virtual clock advanced past the timeout mid-step is detected
+        exactly like a wall-clock hang — which is what makes the fleet's
+        hang drills deterministic."""
+        from poisson_tpu import obs
+
+        with self._lock:
+            if (self.timeout is None or self._last_beat is None
+                    or self._fired):
+                return None
+            elapsed = self._clock() - self._last_beat
+            if elapsed <= self.timeout:
+                return None
+            self._fired = True
+            diag = self._diagnostics(elapsed)
+            self.fired_diagnostics = diag
+        obs.inc("watchdog.stalls")
+        obs.event("watchdog.stall",
+                  elapsed_seconds=diag["elapsed_seconds"],
+                  timeout_seconds=self.timeout,
+                  beats=diag["beats"])
+        self._write_diagnostics(diag)
+        return diag
+
     def raise_if_fired(self) -> None:
         """Convert a watchdog-induced main-thread interrupt into the typed
         abort: the chunked drivers call this from their KeyboardInterrupt

@@ -10,11 +10,20 @@ and poisoned-member bucket isolation, a circuit breaker per
 ladder — every mechanism audible as ``serve.*`` counters/spans
 (``poisson_tpu.obs``) and exportable to Prometheus (``obs.export``).
 
+PR 8 made the service *durable*: a supervised worker fleet
+(``serve.fleet`` — sticky executables, per-worker breakers, heartbeat
+watchdogs, quarantine → warm-up restart) and a CRC-sealed write-ahead
+journal (``serve.journal``) whose replay recovers queued and
+lane-resident requests after a crash without double-admitting or
+double-delivering.
+
 The load-bearing invariant, asserted by the chaos campaign
 (``poisson_tpu.testing.chaos``; ``python -m poisson_tpu chaos --all``):
 every admitted request terminates with exactly one typed outcome —
 result, typed error, or typed shed. ``admitted − (completed + errors +
-shed) == 0``; no request is ever silently lost.
+shed) == 0``; no request is ever silently lost — now including across a
+process kill/replay boundary, where the merged per-process ``serve.*``
+snapshots close the same equation.
 
     from poisson_tpu.serve import SolveRequest, SolveService
     svc = SolveService()
@@ -24,6 +33,21 @@ shed) == 0``; no request is ever silently lost.
 
 from poisson_tpu.serve.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from poisson_tpu.serve.deadline import Deadline
+from poisson_tpu.serve.fleet import (
+    WORKER_DEAD,
+    WORKER_QUARANTINED,
+    WORKER_RUNNING,
+    Worker,
+    WorkerCrashError,
+    WorkerHangError,
+    WorkerPool,
+)
+from poisson_tpu.serve.journal import (
+    JournalReplay,
+    PendingRequest,
+    SolveJournal,
+    replay_journal,
+)
 from poisson_tpu.serve.service import (
     SolveService,
     p99_exemplar,
@@ -38,6 +62,7 @@ from poisson_tpu.serve.types import (
     OUTCOME_SHED,
     BreakerPolicy,
     DegradationPolicy,
+    FleetPolicy,
     Outcome,
     RetryPolicy,
     SCHED_CONTINUOUS,
@@ -54,10 +79,13 @@ from poisson_tpu.serve.types import (
 __all__ = [
     "BreakerPolicy", "CircuitBreaker", "CLOSED", "Deadline",
     "DegradationPolicy", "ERROR_DIVERGENCE", "ERROR_INTERNAL",
-    "ERROR_TRANSIENT", "HALF_OPEN", "OPEN", "Outcome", "OUTCOME_ERROR",
-    "OUTCOME_RESULT", "OUTCOME_SHED", "RetryPolicy", "SCHED_CONTINUOUS",
-    "SCHED_DRAIN", "ServicePolicy",
+    "ERROR_TRANSIENT", "FleetPolicy", "HALF_OPEN", "JournalReplay",
+    "OPEN", "Outcome", "OUTCOME_ERROR",
+    "OUTCOME_RESULT", "OUTCOME_SHED", "PendingRequest", "RetryPolicy",
+    "SCHED_CONTINUOUS", "SCHED_DRAIN", "ServicePolicy",
     "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED", "SHED_QUEUE_FULL",
-    "SLOPolicy", "SolveRequest", "SolveService",
-    "TransientDispatchError", "p99_exemplar", "slowest_requests",
+    "SLOPolicy", "SolveJournal", "SolveRequest", "SolveService",
+    "TransientDispatchError", "WORKER_DEAD", "WORKER_QUARANTINED",
+    "WORKER_RUNNING", "Worker", "WorkerCrashError", "WorkerHangError",
+    "WorkerPool", "p99_exemplar", "replay_journal", "slowest_requests",
 ]

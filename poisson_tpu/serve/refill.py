@@ -48,17 +48,20 @@ class LaneTable:
     ``entries[lane]`` is the occupant (None = EMPTY)."""
 
     def __init__(self, cohort: str, problem, dtype, bucket: int,
-                 chunk: int):
+                 chunk: int, worker_id: int = 0):
         self.cohort = cohort
         self.problem = problem
+        self.worker_id = worker_id
         self.batch = LaneBatch(
             problem, bucket, dtype=dtype, chunk=chunk,
             # Chunk-boundary hook (solvers.lanes): each boundary is a
             # timeline event, so a wedged lane program's last boundary
-            # is on disk for forensics. Host-side only — flag-off lane
-            # programs are byte-identical.
+            # is on disk for forensics — attributed to the worker that
+            # owns the program (serve.fleet). Host-side only — flag-off
+            # lane programs are byte-identical.
             on_boundary=lambda acc: obs.event(
-                "serve.refill.chunk_boundary", cohort=cohort, **acc),
+                "serve.refill.chunk_boundary", cohort=cohort,
+                worker=worker_id, **acc),
         )
         self.entries: List[Optional[object]] = [None] * self.batch.bucket
         self.dtype_name = self.batch.dtype_name
@@ -108,7 +111,8 @@ class LaneTable:
         obs.inc("serve.refill.splices")
         obs.event("serve.refill.splice", cohort=self.cohort, lane=lane,
                   request_id=str(entry.request.request_id),
-                  occupancy=len(self.occupants()))
+                  occupancy=len(self.occupants()),
+                  worker=self.worker_id)
         return lane
 
     def step(self) -> dict:
@@ -158,7 +162,8 @@ class LaneTable:
         obs.inc("serve.refill.retired_lanes")
         obs.event("serve.refill.retire", cohort=self.cohort, lane=lane,
                   request_id=str(entry.request.request_id),
-                  iterations=result.iterations, flag=result.flag_name)
+                  iterations=result.iterations, flag=result.flag_name,
+                  worker=self.worker_id)
         return entry, result
 
     def evict_all(self) -> List[object]:

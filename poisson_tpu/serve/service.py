@@ -34,10 +34,27 @@ request level (the shape Orca, PAPERS.md, gives a serving stack):
   (``serve.slo.*`` counters/histogram/burn rates) that the degradation
   ladder can consult (``SLOPolicy.degrade_on_burn``).
 
+- **the solve fleet** (``serve.fleet``) — ``FleetPolicy(workers=N)``
+  runs N supervised dispatch contexts over this one queue and ledger:
+  sticky bucket executables, per-worker breaker cohorts and lane
+  tables, heartbeat watchdogs; a crashed/hung worker is quarantined,
+  its in-flight requests recovered onto the survivors, and it restarts
+  through warm-up;
+- **durability** (``serve.journal``) — an optional CRC-sealed
+  write-ahead journal records every transition, and
+  :meth:`SolveService.recover` replays it after a crash: prior outcomes
+  are deduplicated, pending requests re-enqueue as ``serve.recovered``
+  (never re-admitted), and the merged per-process snapshots close the
+  invariant across the kill/replay boundary.
+
 The service is deliberately single-threaded and clock/sleep-injectable:
 the dispatch loop IS the unit under chaos test, and determinism (seeded
 jitter, virtual clocks) is what makes the chaos campaign a regression
-suite instead of a flake generator.
+suite instead of a flake generator. Fleet workers are cooperatively
+scheduled dispatch contexts on that same loop — the supervisor state
+machine (quarantine, restart, recovery) is the deterministic substrate
+chaos needs; mapping workers onto OS threads or processes is a
+deployment concern the API does not preclude.
 """
 
 from __future__ import annotations
@@ -53,6 +70,8 @@ from poisson_tpu import obs
 from poisson_tpu.obs.costs import apportion_compute
 from poisson_tpu.obs.flight import (
     POINT_DEADLINE,
+    POINT_QUARANTINE,
+    POINT_RECOVERED,
     POINT_RETRY,
     SPAN_BACKOFF,
     SPAN_QUEUE,
@@ -62,6 +81,13 @@ from poisson_tpu.obs.flight import (
 )
 from poisson_tpu.serve.breaker import CircuitBreaker
 from poisson_tpu.serve.deadline import Deadline
+from poisson_tpu.serve.fleet import (
+    WORKER_RUNNING,
+    Worker,
+    WorkerCrashError,
+    WorkerHangError,
+    WorkerPool,
+)
 from poisson_tpu.serve.types import (
     ERROR_DIVERGENCE,
     ERROR_INTERNAL,
@@ -86,7 +112,7 @@ class _Entry:
 
     __slots__ = ("request", "admitted_at", "deadline", "attempts",
                  "taint", "not_before", "escalate", "last_failure",
-                 "iter_cap")
+                 "iter_cap", "recovered")
 
     def __init__(self, request: SolveRequest, admitted_at: float,
                  deadline: Optional[Deadline]):
@@ -99,6 +125,7 @@ class _Entry:
         self.escalate = False      # next dispatch via the resilient driver
         self.last_failure = ""
         self.iter_cap = None       # degraded per-member cap (lane splices)
+        self.recovered = False     # pulled off a dead worker / the journal
 
 
 def _percentile(sorted_vals: Sequence[float], q: float) -> float:
@@ -154,7 +181,9 @@ class SolveService:
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Optional[Callable[[float], None]] = None,
                  seed: int = 0,
-                 dispatch_fault: Optional[Callable] = None):
+                 dispatch_fault: Optional[Callable] = None,
+                 worker_fault: Optional[Callable] = None,
+                 journal=None):
         self.policy = policy or ServicePolicy()
         if self.policy.capacity < 1:
             raise ValueError("service capacity must be >= 1")
@@ -171,16 +200,30 @@ class SolveService:
         self._sleep = sleep if sleep is not None else time.sleep
         self._rng = random.Random(seed)
         self._dispatch_fault = dispatch_fault
+        # The worker-fault seam: called as (worker_id, requests,
+        # attempts) right where dispatch_fault is, it may raise
+        # WorkerCrashError/WorkerHangError — faults of the WORKER, which
+        # quarantine it and recover its in-flight requests, where
+        # dispatch faults only cost the dispatch.
+        self._worker_fault = worker_fault
+        # Write-ahead journal (serve.journal.SolveJournal or None):
+        # every lifecycle transition below is recorded before the
+        # in-memory ledger moves, so a crash can be replayed.
+        self._journal = journal
         self._queue: deque = deque()
         self._delayed: List[_Entry] = []
         self._pending_ids: set = set()  # ids queued or backing off
-        self._breakers: dict = {}
         self._outcomes: dict = {}
+        self._prior_outcomes: dict = {}  # journal-replayed (pre-crash)
+        self._recovered_ids: set = set()  # str ids that came via replay
         self._order: List = []          # outcome completion order
         self._latencies: List[float] = []
         self._counts = {"admitted": 0, "completed": 0, "errors": 0,
-                        "shed": 0}
-        self._table = None   # continuous mode's live LaneTable (or None)
+                        "shed": 0, "recovered": 0}
+        # The worker pool: N dispatch contexts over this one queue and
+        # ledger (serve.fleet; workers=1 is the classic single-worker
+        # service — same scheduling decisions, same golden outcomes).
+        self._pool = WorkerPool(self.policy.fleet, clock=clock)
         # Flight recorder + SLO tracker (obs.flight): per-request causal
         # span trees on the service clock, latency decomposition on
         # every outcome, and the serve.slo.* accounting the degradation
@@ -197,16 +240,43 @@ class SolveService:
         immediately iff the request was shed at admission (queue full);
         None when it was queued — its outcome arrives via :meth:`drain`.
         Either way the request is admitted for accounting: exactly one
-        typed outcome will exist for it."""
-        if (request.request_id in self._outcomes
-                or request.request_id in self._pending_ids):
-            raise ValueError(
-                f"duplicate request_id {request.request_id!r} — the "
-                "one-outcome-per-request ledger needs unique ids"
-            )
+        typed outcome will exist for it.
+
+        With ``policy.dedup`` on, a re-submitted ``request_id`` is an
+        idempotent no-op: the original outcome comes back (None while
+        still pending), a ``serve.dedup.hits`` is counted, and nothing
+        is re-admitted — a client retry or a replayed submission can
+        never double-enter the ledger."""
+        # The journal stringifies ids, so a recovered/replayed request
+        # lives under str(id): a client retry with the original (e.g.
+        # int) id must still hit the guard. The str-spelling check is
+        # scoped to ids that actually came through a replay
+        # (_recovered_ids) — outside recovery, distinct ids that merely
+        # collide under str() (1 vs "1") stay distinct requests.
+        rid = request.request_id
+        recovered_twin = str(rid) in self._recovered_ids
+        seen = (rid in self._outcomes or rid in self._prior_outcomes
+                or rid in self._pending_ids or recovered_twin)
+        if seen:
+            if not self.policy.dedup:
+                raise ValueError(
+                    f"duplicate request_id {request.request_id!r} — the "
+                    "one-outcome-per-request ledger needs unique ids"
+                )
+            obs.inc("serve.dedup.hits")
+            obs.event("serve.dedup.hit",
+                      request_id=str(request.request_id))
+            out = (self._outcomes.get(rid)
+                   or self._prior_outcomes.get(rid))
+            if out is None and recovered_twin:
+                out = (self._outcomes.get(str(rid))
+                       or self._prior_outcomes.get(str(rid)))
+            return out
         self._counts["admitted"] += 1
         obs.inc("serve.admitted")
-        self._flight.admit(request.request_id)   # causal trace root
+        trace_id = self._flight.admit(request.request_id)  # trace root
+        if self._journal is not None:
+            self._journal.submit(request, trace_id)
         now = self._clock()
         deadline = (Deadline(request.deadline_seconds, clock=self._clock)
                     if request.deadline_seconds is not None else None)
@@ -240,10 +310,187 @@ class SolveService:
         mode. Returns False when no admitted request is pending. This is
         the open-loop seam: a load generator interleaves ``submit`` with
         ``pump`` so arrivals can join work already in flight
-        (``bench.py --serve --arrival-rate``)."""
+        (``bench.py --serve --arrival-rate``).
+
+        With a multi-worker fleet, each pump schedules ONE worker
+        (sticky-preferred, else round-robin), restarting due-quarantined
+        workers through warm-up first; with no runnable worker the pump
+        either waits out the earliest quarantine or — the whole fleet
+        dead — fails the remaining backlog with typed internal errors,
+        so the ledger invariant survives even total fleet loss."""
+        self._restart_due_workers()
+        worker = self._pool.next_worker(self._head_cohort())
+        if worker is None:
+            return self._no_worker_step()
+        # Beat only when the step has work: the beat marks the step's
+        # START (the baseline the post-step stall check measures from),
+        # and an idle open-loop pump must neither flood the telemetry
+        # rails with no-op beats nor let idle wait read as a stall.
+        active = bool(self._queue or self._delayed
+                      or (worker.table is not None
+                          and worker.table.occupied()))
+        if active:
+            worker.watchdog.beat(worker=worker.id)
         if self.policy.scheduling == SCHED_CONTINUOUS:
-            return self._step_continuous()
-        return self._step()
+            progressed = self._step_continuous(worker)
+        else:
+            progressed = self._step(worker)
+        if active:
+            self._post_step_health(worker)
+        return progressed
+
+    # -- fleet supervision ---------------------------------------------
+
+    @property
+    def _table(self):
+        """Worker 0's live lane table — the pre-fleet single-worker
+        view (tables are per worker now; multi-worker callers inspect
+        ``self._pool.workers[i].table``)."""
+        return self._pool.workers[0].table
+
+    def _head_cohort(self) -> Optional[str]:
+        if not self._queue:
+            return None
+        return self._cohort(self._queue[0].request)
+
+    def _restart_due_workers(self) -> None:
+        for worker in self._pool.release_due():
+            sticky = self._pool.restart(worker)
+            if sticky:
+                self._warm_worker(worker, sticky)
+
+    def _note_sticky(self, worker: Worker, cohort: str, problem, dtype,
+                     bucket=None) -> None:
+        """Record that ``worker`` holds ``cohort``'s executable at
+        ``bucket`` width — what routing prefers and restart warm-up
+        recompiles."""
+        info = worker.sticky.setdefault(
+            cohort, {"problem": problem, "dtype": dtype, "buckets": set()})
+        if bucket:
+            info["buckets"].add(int(bucket))
+
+    def _warm_worker(self, worker: Worker, sticky: dict) -> None:
+        """Restart warm-up: recompile (or jit-cache-hit) each sticky
+        bucket executable — at the widths the worker actually
+        dispatched, with degenerate zero-gate members — before the
+        worker takes traffic: a restarted worker must not absorb a
+        compile spike into the first real request's latency. (Lane
+        stepping programs recompile on first table build instead; with
+        cooperative workers the process-wide jit cache usually makes
+        all of this a cache hit — the warm-up is the guarantee, not
+        the common cost.)"""
+        from poisson_tpu.solvers.batched import solve_batched
+
+        for cohort, info in sticky.items():
+            for width in sorted(info["buckets"]) or [1]:
+                try:
+                    solve_batched(info["problem"],
+                                  rhs_gates=[0.0] * width,
+                                  dtype=info["dtype"], bucket=width)
+                    obs.inc("serve.fleet.warmup_solves")
+                except Exception as e:   # warm-up is best-effort
+                    obs.inc("serve.fleet.warmup_failures")
+                    obs.event("serve.fleet.warmup_failure",
+                              worker=worker.id, cohort=cohort,
+                              bucket=width,
+                              error=f"{type(e).__name__}: {e}")
+        obs.event("serve.fleet.warmed", worker=worker.id,
+                  cohorts=len(sticky))
+
+    def _post_step_health(self, worker: Worker) -> None:
+        """After a step that did NOT raise a worker fault: the heartbeat
+        may still show the step overran the watchdog (a slow wedge that
+        eventually returned). Quarantine post hoc — outcomes the step
+        produced stand; the worker does not take more traffic until it
+        restarts."""
+        if worker.state != WORKER_RUNNING:
+            return
+        if worker.watchdog.check() is not None:
+            obs.inc("serve.fleet.hangs")
+            self._quarantine_worker(worker, "stall")
+
+    def _quarantine_worker(self, worker: Worker, reason: str) -> None:
+        """Quarantine ``worker``, recovering any lane occupants it still
+        holds (their in-flight progress died with the worker)."""
+        evicted = []
+        if worker.table is not None:
+            evicted = worker.table.evict_all()
+            worker.table = None
+            for entry in evicted:
+                self._flight.end(entry.request.request_id, SPAN_RESIDENT,
+                                 error=reason)
+        self._pool.quarantine(worker, reason)
+        if evicted:
+            self._recover_entries(worker, evicted, reason)
+
+    def _recover_entries(self, worker: Worker, entries: List[_Entry],
+                         reason: str) -> None:
+        """Re-dispatch a fallen worker's in-flight requests to the
+        survivors: mutual taint (the worker's death may have been one of
+        them), recovery backoff, ``recovered``/``quarantine`` flight
+        points — then the ordinary retry budget decides retry vs typed
+        error."""
+        co_ids = {e.request.request_id for e in entries}
+        for entry in entries:
+            rid = entry.request.request_id
+            entry.recovered = True
+            obs.inc("serve.fleet.recovered_requests")
+            self._flight.point(rid, POINT_QUARANTINE, worker=worker.id,
+                               reason=reason)
+            self._flight.point(rid, POINT_RECOVERED, worker=worker.id,
+                               reason=reason)
+            self._retry_or_fail(entry, ERROR_TRANSIENT,
+                                f"worker {worker.id} {reason} "
+                                "mid-dispatch", co_ids - {rid})
+
+    def _handle_worker_fault(self, worker: Worker, exc: Exception,
+                             entries: List[_Entry], did: str,
+                             t0: float) -> None:
+        """A dispatch raised a worker-level fault: close the affected
+        flight spans, evict any lane occupants the worker still holds
+        (a solo dispatch can crash a worker whose lane table is live),
+        quarantine it, and recover everything onto the survivors."""
+        hang = isinstance(exc, WorkerHangError)
+        reason = "hang" if hang else "crash"
+        if hang and worker.watchdog.check() is not None:
+            obs.inc("serve.fleet.hangs")
+        self._flight_dispatch_failed(entries, did, t0,
+                                     type(exc).__name__)
+        extra = []
+        if worker.table is not None:
+            known = {id(e) for e in entries}
+            extra = [e for e in worker.table.evict_all()
+                     if id(e) not in known]
+            worker.table = None
+            for entry in extra:
+                self._flight.end(entry.request.request_id, SPAN_RESIDENT,
+                                 error=type(exc).__name__)
+        self._pool.quarantine(worker, reason)
+        self._recover_entries(worker, list(entries) + extra, reason)
+
+    def _no_worker_step(self) -> bool:
+        """No runnable worker. Wait out the earliest quarantine when one
+        will come back; with the whole fleet dead, every pending request
+        still gets its one typed outcome — as an internal error."""
+        release = self._pool.earliest_release()
+        if release is not None:
+            if not self._pending_ids:
+                return False
+            self._sleep(max(0.0, release - self._clock()))
+            return True
+        if not self._pool.all_dead():
+            return bool(self._pending_ids)
+        self._pump_delayed()
+        while self._delayed:          # backoff cannot outlive the fleet
+            self._queue.append(self._delayed.pop(0))
+        progressed = False
+        while self._queue:
+            entry = self._queue.popleft()
+            self._error(entry, ERROR_INTERNAL,
+                        "no live workers: every worker in the fleet is "
+                        "dead (restart budget exhausted)")
+            progressed = True
+        return progressed
 
     def _advance_past_backoff(self) -> bool:
         """Everything runnable is backing off: advance to the earliest
@@ -278,7 +525,7 @@ class SolveService:
             return None
         return head
 
-    def _step(self) -> bool:
+    def _step(self, worker: Worker) -> bool:
         self._pump_delayed()
         if not self._queue and not self._advance_past_backoff():
             return False
@@ -291,14 +538,14 @@ class SolveService:
         # carved out of it.
         level = self._load_level(len(self._queue) + len(self._delayed) + 1)
         batch = self._form_batch(head)
-        breaker = self._breaker(self._cohort(head.request))
+        breaker = self._breaker(worker, self._cohort(head.request))
         if not breaker.allow():
             for entry in batch:
                 self._shed(entry, SHED_BREAKER_OPEN,
                            f"circuit breaker open for cohort "
                            f"{self._cohort(entry.request)}")
             return True
-        self._dispatch(batch, breaker, level)
+        self._dispatch(worker, batch, breaker, level)
         return True
 
     def _pump_delayed(self) -> None:
@@ -324,11 +571,14 @@ class SolveService:
         p = request.problem
         return f"{p.M}x{p.N}:{request.dtype or 'auto'}:xla"
 
-    def _breaker(self, cohort: str) -> CircuitBreaker:
-        if cohort not in self._breakers:
-            self._breakers[cohort] = CircuitBreaker(
+    def _breaker(self, worker: Worker, cohort: str) -> CircuitBreaker:
+        """The ``worker``'s breaker for ``cohort``: breaker state is
+        keyed per worker cohort (a wedged worker trips its own breakers,
+        not the fleet's — ROADMAP item 3)."""
+        if cohort not in worker.breakers:
+            worker.breakers[cohort] = CircuitBreaker(
                 self.policy.breaker, clock=self._clock, cohort=cohort)
-        return self._breakers[cohort]
+        return worker.breakers[cohort]
 
     def _solo(self, entry: _Entry) -> bool:
         """Chunked single-request dispatch classes: deadline-carrying
@@ -407,44 +657,53 @@ class SolveService:
         p = entry.request.problem
         return f"{p.M}x{p.N}:{self._effective_dtype(entry, level)}:xla"
 
-    def _step_continuous(self) -> bool:
+    def _step_continuous(self, worker: Worker) -> bool:
         """One cycle of the refill engine: promote backed-off work,
         dispatch a solo-class head, refill EMPTY lanes from the queue
         (policy re-checked per splice), then advance every ACTIVE lane
         one chunk and retire what the boundary shows as finished."""
         self._pump_delayed()
-        busy = self._table is not None and self._table.occupied()
+        busy = worker.table is not None and worker.table.occupied()
         if not self._queue and not busy:
+            # Another worker's lanes may still be live: this worker has
+            # nothing, but the service does.
+            if self._busy_elsewhere(worker):
+                return True
             if not self._advance_past_backoff():
-                self._table = None
+                worker.table = None
                 return False
         # A solo-class head (escalated retry, explicit chunk) dispatches
         # between chunk steps through the drain-mode machinery — the
         # lane program pauses in wall time but burns no iterations.
         if self._queue and not self._lane_eligible(self._queue[0]):
-            return self._dispatch_head_solo()
-        self._refill()
-        if self._table is not None and self._table.occupied():
-            self._step_lane_table()
+            return self._dispatch_head_solo(worker)
+        self._refill(worker)
+        if worker.table is not None and worker.table.occupied():
+            self._step_lane_table(worker)
             return True
-        return bool(self._queue or self._delayed)
+        return bool(self._queue or self._delayed
+                    or self._busy_elsewhere(worker))
 
-    def _dispatch_head_solo(self) -> bool:
+    def _busy_elsewhere(self, worker: Worker) -> bool:
+        return any(w.table is not None and w.table.occupied()
+                   for w in self._pool.workers if w is not worker)
+
+    def _dispatch_head_solo(self, worker: Worker) -> bool:
         head = self._pop_live_head()
         if head is None:
             return True
         level = self._load_level(len(self._queue) + len(self._delayed)
                                  + 1)
-        breaker = self._breaker(self._cohort(head.request))
+        breaker = self._breaker(worker, self._cohort(head.request))
         if not breaker.allow():
             self._shed(head, SHED_BREAKER_OPEN,
                        f"circuit breaker open for cohort "
                        f"{self._cohort(head.request)}")
             return True
-        self._dispatch([head], breaker, level)
+        self._dispatch(worker, [head], breaker, level)
         return True
 
-    def _refill(self) -> None:
+    def _refill(self, worker: Worker) -> None:
         """The refill decision: splice queued, lane-eligible requests
         into the live table's EMPTY lanes. Every policy is re-checked
         per splice — deadline liveness, taint-pair exclusion against the
@@ -465,7 +724,7 @@ class SolveService:
         head_cohort = self._lane_cohort(head, level)
         from poisson_tpu.serve.breaker import OPEN
 
-        if self._breaker(head_cohort).state == OPEN:
+        if self._breaker(worker, head_cohort).state == OPEN:
             # An OPEN breaker (cooldown still running) can admit nothing
             # for this cohort: shed the head without paying lane-table
             # construction for a program no splice could ever enter.
@@ -495,7 +754,7 @@ class SolveService:
             # is audible as serve.refill.idle_lane_steps.
             bucket = bucket_size(
                 min(max(ready + 1, 2), self.policy.max_batch))
-        table = self._table
+        table = worker.table
         # An in-flight program is immutable (fixed executable width); an
         # EMPTY one is replaceable — on cohort change, or to re-size the
         # bucket to the backlog the load has grown (or shrunk) into.
@@ -503,18 +762,22 @@ class SolveService:
                 table.cohort != head_cohort
                 or table.problem != head.request.problem
                 or table.bucket != bucket):
-            table = self._table = None
+            table = worker.table = None
         if table is None:
             if level >= 1:
                 obs.inc("serve.degraded.padding")
             eff_dtype = self._effective_dtype(head, level)
-            table = self._table = LaneTable(
+            table = worker.table = LaneTable(
                 head_cohort, head.request.problem,
                 None if eff_dtype == "auto" else eff_dtype,
                 bucket, self.policy.refill_chunk,
+                worker_id=worker.id,
             )
+            self._note_sticky(worker, head_cohort, head.request.problem,
+                              None if eff_dtype == "auto" else eff_dtype,
+                              bucket)
             obs.event("serve.refill.table", cohort=head_cohort,
-                      bucket=bucket, level=level)
+                      bucket=bucket, level=level, worker=worker.id)
         if not table.free_lane_count():
             return
         kept: deque = deque()
@@ -537,7 +800,7 @@ class SolveService:
             if not table.taint_compatible(entry):
                 kept.append(entry)     # waits for its taint partner
                 continue
-            breaker = self._breaker(table.cohort)
+            breaker = self._breaker(worker, table.cohort)
             if not breaker.allow():
                 obs.inc("serve.refill.refill_denied_by_breaker")
                 self._shed(entry, SHED_BREAKER_OPEN,
@@ -559,39 +822,57 @@ class SolveService:
                 obs.inc("serve.degraded.precision")
             lane = table.splice(entry, entry.request.rhs_gate)
             rid = entry.request.request_id
+            if self._journal is not None:
+                self._journal.record("splice", request_id=str(rid),
+                                     worker=worker.id, lane=lane)
             self._flight.end(rid, SPAN_QUEUE)
             self._flight.begin(rid, SPAN_RESIDENT, mode="lane",
                                bucket=table.bucket, lane=lane,
-                               level=level)
+                               level=level, worker=worker.id)
         while kept:        # skipped entries return in arrival order
             self._queue.appendleft(kept.pop())
 
-    def _step_lane_table(self) -> None:
+    def _step_lane_table(self, worker: Worker) -> None:
         """Advance the lane program one chunk through the dispatch-fault
         seam, then classify the boundary. A transient fault kills the
         device program: every occupant is evicted and retried with
-        mutual taint (the batch-drain contract, applied to lanes); an
-        internal fault surfaces every occupant as a typed error."""
-        table = self._table
-        breaker = self._breaker(table.cohort)
+        mutual taint (the batch-drain contract, applied to lanes); a
+        worker fault quarantines the worker and recovers the occupants
+        onto the survivors; an internal fault surfaces every occupant as
+        a typed error."""
+        table = worker.table
+        breaker = self._breaker(worker, table.cohort)
         occupants = table.occupants()
         did = self._flight.next_dispatch_id()
         t_step = self._clock()
         try:
             with obs.span("serve.refill.step", fence=False,
-                          cohort=table.cohort, active=len(occupants)):
+                          cohort=table.cohort, active=len(occupants),
+                          worker=worker.id):
+                if self._worker_fault is not None:
+                    self._worker_fault(worker.id,
+                                       [e.request for e in occupants],
+                                       {e.request.request_id: e.attempts
+                                        for e in occupants})
                 if self._dispatch_fault is not None:
                     self._dispatch_fault(
                         [e.request for e in occupants],
                         {e.request.request_id: e.attempts
                          for e in occupants})
+                # No beat here: the pump-level beat marked the step's
+                # START, and the post-step stall check must measure this
+                # step's duration — a beat on completion would reset the
+                # baseline and make a slow-but-returning step invisible.
                 table.step()
+        except (WorkerCrashError, WorkerHangError) as e:
+            self._handle_worker_fault(worker, e, occupants, did, t_step)
+            return
         except TransientDispatchError as e:
             breaker.record_failure()
             self._flight_dispatch_failed(occupants, did, t_step,
                                          type(e).__name__)
             evicted = table.evict_all()
-            self._table = None
+            worker.table = None
             co_ids = {en.request.request_id for en in evicted}
             for en in evicted:
                 self._retry_or_fail(en, ERROR_TRANSIENT, str(e),
@@ -602,7 +883,7 @@ class SolveService:
             self._flight_dispatch_failed(occupants, did, t_step,
                                          type(e).__name__)
             evicted = table.evict_all()
-            self._table = None
+            worker.table = None
             for en in evicted:
                 self._error(en, ERROR_INTERNAL,
                             f"{type(e).__name__}: {e}")
@@ -639,6 +920,11 @@ class SolveService:
             if not (view["done"] or view["k"] >= cap or deadline_hit):
                 continue               # still ACTIVE: rides the next chunk
             entry, result = table.retire(view["lane"])
+            if self._journal is not None:
+                self._journal.record(
+                    "retire", request_id=str(entry.request.request_id),
+                    iterations=int(result.iterations),
+                    flag=result.flag_name)
             if deadline_hit:
                 self._flight.point(entry.request.request_id,
                                    POINT_DEADLINE, where="lane",
@@ -667,8 +953,8 @@ class SolveService:
 
     # -- dispatch ------------------------------------------------------
 
-    def _dispatch(self, batch: List[_Entry], breaker: CircuitBreaker,
-                  level: int) -> None:
+    def _dispatch(self, worker: Worker, batch: List[_Entry],
+                  breaker: CircuitBreaker, level: int) -> None:
         from poisson_tpu.solvers.pcg import resolve_dtype
 
         policy = self.policy
@@ -696,21 +982,46 @@ class SolveService:
         obs.inc("serve.dispatches")
         obs.inc("serve.batch_members", len(batch))
         cohort = self._cohort(head.request)
+        # Sticky executables: this worker now holds the cohort's
+        # compiled program at this bucket width — routing will prefer
+        # it, and a restart warm-up recompiles exactly these widths.
+        solo_head = len(batch) == 1 and self._solo(head)
+        if solo_head:
+            width = None          # chunked drivers, no bucket program
+        elif exact_bucket:
+            width = len(batch)
+        else:
+            from poisson_tpu.solvers.batched import bucket_size
+
+            width = bucket_size(len(batch))
+        self._note_sticky(worker, cohort, head.request.problem,
+                          head.request.dtype, width)
         # Flight: members leave the queue and become resident in one
         # shared dispatch — the dispatch id is the causal parent linking
         # every member's residency span and chunk-step points.
         did = self._flight.next_dispatch_id()
-        solo = len(batch) == 1 and self._solo(head)
+        solo = solo_head
         mode = "solo" if solo else "drain"
         for entry in batch:
             rid = entry.request.request_id
             self._flight.end(rid, SPAN_QUEUE)
             self._flight.begin(rid, SPAN_RESIDENT, dispatch=did,
-                               mode=mode, batch=len(batch), level=level)
+                               mode=mode, batch=len(batch), level=level,
+                               worker=worker.id)
+        if self._journal is not None:
+            self._journal.record(
+                "dispatch", worker=worker.id, mode=mode,
+                request_ids=[str(e.request.request_id) for e in batch])
         t_disp = self._clock()
         try:
             with obs.span("serve.dispatch", fence=False, cohort=cohort,
-                          batch=len(batch), level=level):
+                          batch=len(batch), level=level,
+                          worker=worker.id):
+                if self._worker_fault is not None:
+                    self._worker_fault(worker.id,
+                                       [e.request for e in batch],
+                                       {e.request.request_id: e.attempts
+                                        for e in batch})
                 if self._dispatch_fault is not None:
                     self._dispatch_fault([e.request for e in batch],
                                          {e.request.request_id: e.attempts
@@ -721,6 +1032,12 @@ class SolveService:
                 else:
                     member_failed = self._dispatch_batched(
                         batch, problem, dtype, exact_bucket, did, t_disp)
+                # No completion beat — see _step_lane_table: the
+                # post-step stall check measures from the pump-level
+                # start-of-step beat.
+        except (WorkerCrashError, WorkerHangError) as e:
+            self._handle_worker_fault(worker, e, batch, did, t_disp)
+            return
         except TransientDispatchError as e:
             breaker.record_failure()
             self._flight_dispatch_failed(batch, did, t_disp,
@@ -911,6 +1228,15 @@ class SolveService:
         obs.inc("serve.backoff_seconds", delay)
         if co_ids:
             obs.inc("serve.requeued.isolated")
+        if self._journal is not None:
+            # Taint rides the record: the never-co-batch-again pairs
+            # must survive a crash while the entry is backing off, or
+            # replay would re-batch a poison with its old victims.
+            self._journal.record(
+                "requeue", request_id=str(entry.request.request_id),
+                attempt=entry.attempts, error=error_type,
+                recovered=entry.recovered,
+                taint=sorted(str(t) for t in entry.taint))
         obs.event("serve.retry", request_id=str(entry.request.request_id),
                   attempt=entry.attempts, delay=round(delay, 4),
                   error=error_type, escalate=entry.escalate)
@@ -936,6 +1262,13 @@ class SolveService:
         self._outcomes[outcome.request_id] = outcome
         self._order.append(outcome.request_id)
         self._latencies.append(outcome.latency_seconds)
+        if self._journal is not None:
+            self._journal.record(
+                "outcome", request_id=str(outcome.request_id),
+                outcome=outcome.kind,
+                type=(outcome.error_type or outcome.shed_reason
+                      or outcome.flag),
+                attempts=outcome.attempts)
         obs.gauge("serve.queue_depth",
                   len(self._queue) + len(self._delayed))
         return outcome
@@ -1013,6 +1346,93 @@ class SolveService:
             trace_id=fo["trace_id"], decomposition=fo["decomposition"],
         ))
 
+    # -- crash recovery (serve.journal) --------------------------------
+
+    @classmethod
+    def recover(cls, journal, policy: Optional[ServicePolicy] = None,
+                **kwargs) -> "SolveService":
+        """Rebuild a service from ``journal``'s write-ahead log after a
+        crash: replay the log, re-enqueue every request that was queued
+        or in-flight when the previous process died (``recovered``
+        taint/backoff path, counted as ``serve.recovered`` — NOT as a
+        fresh admission, so merged cross-process ``serve.*`` snapshots
+        close the ledger invariant), remember every prior outcome (a
+        replayed or retried submission can never double-admit), and
+        keep journaling into the same file. The replay report rides on
+        the returned service as ``.recovery``."""
+        from poisson_tpu.serve.journal import replay_journal
+
+        replay = replay_journal(journal.path)
+        svc = cls(policy, journal=journal, **kwargs)
+        svc._absorb_replay(replay)
+        return svc
+
+    def _absorb_replay(self, replay) -> None:
+        self.recovery = replay
+        for rid, kind in replay.outcomes.items():
+            # Terminal truth from the previous life: enough to dedup
+            # against; the full Outcome object died with its process.
+            self._prior_outcomes.setdefault(
+                rid, Outcome(request_id=rid, kind=kind,
+                             message="replayed from journal"))
+            self._recovered_ids.add(str(rid))
+        self._recovered_ids.update(
+            str(p.request.request_id) for p in replay.pending)
+        now = self._clock()
+        for pend in replay.pending:
+            req = pend.request
+            # Keep the original admission time when the journal clock is
+            # comparable with ours (same monotonic epoch — true for a
+            # same-boot restart and for shared virtual clocks): latency,
+            # SLO scoring, and the flight decomposition then cover the
+            # crash gap (it lands in overhead_s — nobody worked on the
+            # request while the process was dead). A t_submit from an
+            # incomparable clock (in the future) falls back to now.
+            t_admit = (pend.t_submit
+                       if 0.0 <= pend.t_submit <= now else now)
+            entry = _Entry(
+                req, t_admit,
+                Deadline(req.deadline_seconds, clock=self._clock)
+                if req.deadline_seconds is not None else None)
+            entry.recovered = True
+            entry.attempts = pend.attempts
+            entry.taint = set(pend.taint)
+            self._counts["recovered"] += 1
+            obs.inc("serve.recovered")
+            self._pending_ids.add(req.request_id)
+            rid = req.request_id
+            if pend.trace_id:
+                # Continue the crashed process's causal trace: same
+                # trace id, span ids offset past the dead incarnation's.
+                self._flight.adopt(rid, pend.trace_id, t_admit,
+                                   span_base=1000 * pend.generation)
+            else:
+                self._flight.admit(rid)
+            self._flight.point(rid, POINT_RECOVERED,
+                               reason="journal_replay",
+                               generation=pend.generation,
+                               in_flight=pend.in_flight,
+                               lost_hook=pend.lost_hook)
+            self._flight.begin(rid, SPAN_QUEUE, recovered=True)
+            if self._journal is not None:
+                self._journal.record("recover", request_id=str(rid),
+                                     generation=pend.generation,
+                                     in_flight=pend.in_flight)
+            if pend.in_flight:
+                # Mid-dispatch at the crash: back off before the redo —
+                # the crash may have been this cohort's fault.
+                entry.not_before = now + self.policy.fleet.recovery_backoff
+                self._delayed.append(entry)
+                self._flight.end(rid, SPAN_QUEUE)
+                self._flight.begin(rid, SPAN_BACKOFF, recovered=True)
+            else:
+                self._queue.append(entry)
+        obs.event("serve.recovery", recovered=len(replay.pending),
+                  prior_outcomes=len(replay.outcomes),
+                  torn=replay.torn_records)
+        obs.gauge("serve.queue_depth",
+                  len(self._queue) + len(self._delayed))
+
     # -- accounting ----------------------------------------------------
 
     def outcomes(self) -> List[Outcome]:
@@ -1022,7 +1442,14 @@ class SolveService:
     def stats(self) -> dict:
         """The ledger: admitted vs terminated (the no-lost-request
         invariant is ``lost == 0`` once the queue is drained), latency
-        percentiles on the service clock, and the shed rate."""
+        percentiles on the service clock, and the shed rate.
+
+        ``recovered`` counts requests adopted from a journal replay:
+        they were admitted (and counted) by the crashed process, so this
+        process's ledger balances admitted + recovered against outcomes
+        — and the *merged* cross-process counters balance plain admitted
+        against outcomes, which is how the chaos campaign asserts the
+        invariant across a kill/replay boundary."""
         c = dict(self._counts)
         # Pending = every admitted request without an outcome yet —
         # queued, backing off, OR resident in a lane / mid-dispatch.
@@ -1031,14 +1458,22 @@ class SolveService:
         # is read mid-flight between pump() calls (the open-loop seam).
         pending = len(self._pending_ids)
         lats = sorted(self._latencies)
+        single = self.policy.fleet.workers == 1
+        breakers = {}
+        for w in self._pool.workers:
+            for cohort, b in w.breakers.items():
+                breakers[cohort if single else f"{cohort}@w{w.id}"] = \
+                    b.state
         return {
             "admitted": c["admitted"],
             "completed": c["completed"],
             "errors": c["errors"],
             "shed": c["shed"],
+            "recovered": c["recovered"],
             "pending": pending,
-            "lost": c["admitted"] - (c["completed"] + c["errors"]
-                                     + c["shed"]) - pending,
+            "lost": (c["admitted"] + c["recovered"]
+                     - (c["completed"] + c["errors"] + c["shed"])
+                     - pending),
             "latency_seconds": {
                 "p50": _percentile(lats, 0.50),
                 "p95": _percentile(lats, 0.95),
@@ -1046,8 +1481,8 @@ class SolveService:
             },
             "shed_rate": (c["shed"] / c["admitted"] if c["admitted"]
                           else 0.0),
-            "breakers": {cohort: b.state
-                         for cohort, b in self._breakers.items()},
+            "breakers": breakers,
+            "workers": {w.id: w.state for w in self._pool.workers},
         }
 
     def _publish_stats(self) -> None:

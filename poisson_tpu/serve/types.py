@@ -164,6 +164,42 @@ class DegradationPolicy:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetPolicy:
+    """The durable-fleet knobs (``serve.fleet``): ``workers`` dispatch
+    contexts pull from the shared admission queue, each owning its own
+    sticky bucket executables, circuit-breaker cohort, lane table, and
+    heartbeat watchdog (``parallel.watchdog``).
+
+    A worker that crashes mid-dispatch (``WorkerCrashError`` from the
+    worker-fault seam), hangs past ``heartbeat_timeout`` on the service
+    clock (``WorkerHangError``, or a successful step that overran the
+    watchdog), or keeps poisoning its dispatches is **quarantined** for
+    ``quarantine_seconds``: its in-flight requests are recovered —
+    mutual-tainted, ``recovery_backoff``-delayed, flight-marked
+    ``recovered`` — and re-dispatched to the surviving workers. After
+    cooldown the worker restarts through warm-up (``warm_restart``
+    recompiles its sticky bucket executables before it takes traffic);
+    after ``max_restarts`` restarts it is declared dead and never
+    scheduled again. Every transition is audible as a
+    ``serve.fleet.*`` counter/event.
+
+    ``heartbeat_timeout`` is **opt-in** (None disables the stall
+    verdict): it bounds one dispatch/chunk step on the service clock,
+    and only the operator knows what "too long" means for their grids —
+    a default would mistake a legitimately slow large-grid dispatch
+    (cold compile included) for a hang and evict healthy lane progress.
+    Size it well past the worst healthy step, like the PR 1 watchdog.
+    """
+
+    workers: int = 1
+    heartbeat_timeout: Optional[float] = None
+    quarantine_seconds: float = 0.5
+    max_restarts: int = 3
+    recovery_backoff: float = 0.05
+    warm_restart: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class SLOPolicy:
     """Declared service-level objectives, scored per outcome by the
     flight recorder's :class:`~poisson_tpu.obs.flight.SLOTracker`.
@@ -218,6 +254,15 @@ class ServicePolicy:
     ``refill_chunk`` is the continuous engine's iterations-per-step —
     smaller means fresher refill decisions and tighter deadline
     enforcement, at more host round-trips.
+
+    ``fleet`` sizes and supervises the worker pool (:class:`FleetPolicy`
+    — ``workers=1`` is the single-worker service every prior PR ran).
+    ``dedup`` makes submission idempotent: a second ``submit`` with an
+    already-seen ``request_id`` returns the original outcome (or None
+    while it is still pending) and counts a ``serve.dedup.hits`` —
+    instead of raising — so a client retry or a replayed submission can
+    never double-admit. Off by default: with deduplication off, a
+    recycled id is a caller bug and stays a loud ``ValueError``.
     """
 
     capacity: int = 64
@@ -225,7 +270,9 @@ class ServicePolicy:
     default_chunk: int = 50
     scheduling: str = SCHED_DRAIN
     refill_chunk: int = 25
+    dedup: bool = False
     retry: RetryPolicy = RetryPolicy()
     breaker: BreakerPolicy = BreakerPolicy()
     degradation: DegradationPolicy = DegradationPolicy()
     slo: SLOPolicy = SLOPolicy()
+    fleet: FleetPolicy = FleetPolicy()

@@ -9,9 +9,11 @@ service's no-lost-request invariant, asserted from the emitted
 
 The campaign composes PR 1's solver-level fault injectors
 (``testing.faults``: NaN-at-k, preemption, checkpoint corruption, stall)
-with the service-level faults this PR adds (slow-worker, queue-burst,
-repeated-poison-request) into scenarios that each exercise one named
-survival property end to end:
+with service-level faults (slow-worker, queue-burst,
+repeated-poison-request) and fleet-level faults (worker kill/hang via
+the ``worker_fault`` seam, journal bit-rot, a real subprocess
+kill/restart) into scenarios that each exercise one named survival
+property end to end:
 
 ==========================  ============================================
 scenario                    property under test
@@ -52,6 +54,29 @@ refill-preempt-occupied     a preemption with occupied lanes surfaces
                             every occupant as a typed error, trips the
                             breaker (refill denials counted), and the
                             breaker recovers through the refill path
+fleet-worker-kill-          a worker killed mid-dispatch is quarantined;
+mid-dispatch                its in-flight requests recover onto the
+                            survivors with mutual taint, and the worker
+                            restarts through warm-up
+fleet-worker-hang-          a worker wedged past the heartbeat timeout
+watchdog                    is caught by its watchdog (stall verdict),
+                            quarantined, and its requests recover
+journal-crash-replay        a crash with requests queued AND
+                            lane-resident: journal replay reconstructs
+                            the ledger, re-enqueues the survivors
+                            (recovered taint/backoff), invariant closes
+                            with zero lost and zero duplicated outcomes
+journal-torn-tail           torn/CRC-corrupt journal records are
+                            skipped audibly; recovery still closes the
+                            invariant from the readable prefix
+crash-restart-subprocess    ``python -m poisson_tpu serve`` killed
+                            mid-run (exit 75), restarted against the
+                            journal: the invariant closes ACROSS the
+                            kill/replay boundary from the two emitted
+                            serve.* snapshots
+dedup-idempotent-submit     duplicate client submits (pending and
+                            terminated) dedup against the ledger — the
+                            original outcome returns, nothing re-admits
 ==========================  ============================================
 
 Every scenario resets the metrics registry, runs against a
@@ -764,6 +789,325 @@ def _refill_preempt_occupied(seed: int) -> dict:
         "breaker_recovered_through_refill": after.converged
         and svc.stats()["breakers"][cohort] == CLOSED,
     }, {"after_iterations": after.iterations})
+
+
+# -- durable-fleet scenarios (serve.fleet + serve.journal) --------------
+# Worker faults are injected through the service's worker_fault seam
+# (testing.faults.worker_kill_fault/worker_hang_fault); crash scenarios
+# exercise the write-ahead journal, in-process (abandon the service,
+# recover into a fresh one on the same registry) and across a real
+# process kill (subprocess, exit 75 — the PR 1 preemption convention).
+# The invariant stays admitted − (completed + errors + shed) == 0, read
+# from the emitted serve.* snapshot(s).
+
+
+@scenario("fleet-worker-kill-mid-dispatch")
+def _fleet_worker_kill_mid_dispatch(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        FleetPolicy,
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+        WORKER_RUNNING,
+    )
+    from poisson_tpu.testing.faults import worker_kill_fault
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16, max_batch=4,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.05,
+                              backoff_cap=0.1),
+            degradation=_quiet_degradation(),
+            fleet=FleetPolicy(workers=2, quarantine_seconds=0.02,
+                              recovery_backoff=0.05),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        worker_fault=worker_kill_fault({0}),
+    )
+    p = _problem()
+    for i in range(4):
+        svc.submit(SolveRequest(request_id=f"r{i}", problem=p,
+                                rhs_gate=1.0 + i / 10))
+    outs = {o.request_id: o for o in svc.drain()}
+    workers = svc.stats()["workers"]
+    return _finish("fleet-worker-kill-mid-dispatch", seed, {
+        "all_recovered_and_converged": all(
+            o.converged and o.attempts == 2 for o in outs.values()),
+        "worker_quarantined": _counter("serve.fleet.quarantines") == 1,
+        "in_flight_recovered":
+            _counter("serve.fleet.recovered_requests") == 4,
+        "worker_restarted_through_warmup":
+            _counter("serve.fleet.restarts") >= 1
+            and _counter("serve.fleet.warmup_solves") >= 1,
+        "fleet_healthy_after": all(s == WORKER_RUNNING
+                                   for s in workers.values()),
+    }, {"attempts": sorted(o.attempts for o in outs.values()),
+        "workers": {str(k): v for k, v in workers.items()}})
+
+
+@scenario("fleet-worker-hang-watchdog")
+def _fleet_worker_hang_watchdog(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        FleetPolicy,
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import worker_hang_fault
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16, max_batch=4,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.05,
+                              backoff_cap=0.1),
+            degradation=_quiet_degradation(),
+            fleet=FleetPolicy(workers=2, heartbeat_timeout=0.2,
+                              quarantine_seconds=0.02,
+                              recovery_backoff=0.05),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        # The hang (0.5s on the virtual clock) overruns the 0.2s
+        # heartbeat timeout: the stall verdict must land on the
+        # worker's watchdog before the supervisor quarantines it.
+        worker_fault=worker_hang_fault({0}, 0.5, vc.advance),
+    )
+    p = _problem()
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=f"h{i}", problem=p,
+                                rhs_gate=1.0 + i / 10))
+    outs = {o.request_id: o for o in svc.drain()}
+    return _finish("fleet-worker-hang-watchdog", seed, {
+        "watchdog_caught_the_hang": _counter("watchdog.stalls") >= 1
+        and _counter("serve.fleet.hangs") >= 1,
+        "worker_quarantined": _counter("serve.fleet.quarantines") == 1,
+        "requests_recovered":
+            _counter("serve.fleet.recovered_requests") == 3,
+        "all_converged_on_survivors": all(
+            o.converged and o.attempts == 2 for o in outs.values()),
+    }, {"p99": svc.stats()["latency_seconds"]["p99"]})
+
+
+@scenario("journal-crash-replay")
+def _journal_crash_replay(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        SolveJournal,
+        SolveRequest,
+        SolveService,
+        replay_journal,
+    )
+
+    p = _problem()
+    with tempfile.TemporaryDirectory(prefix="poisson-journal-") as td:
+        path = os.path.join(td, "serve.journal")
+        vc = VirtualClock()
+        policy = _continuous_policy(capacity=16, max_batch=2,
+                                    refill_chunk=10)
+        journal_a = SolveJournal(path, clock=vc)
+        svc_a = SolveService(policy, clock=vc, sleep=vc.sleep,
+                             seed=seed, journal=journal_a)
+        for i in range(4):
+            svc_a.submit(SolveRequest(request_id=f"req-{i}", problem=p,
+                                      rhs_gate=1.0 + i / 10))
+        # Run until exactly two outcomes exist, then one more pump so
+        # the remaining two have SPLICED into the freed lanes — the
+        # process "dies" with both survivors genuinely lane-resident,
+        # mid-flight, which is the recovery case that matters.
+        while len(svc_a.outcomes()) < 2:
+            svc_a.pump()
+        svc_a.pump()
+        journal_a.close()
+        # Restart: a fresh service replays the same journal on the SAME
+        # metrics registry (the merged-counters model of two processes).
+        journal_b = SolveJournal(path, clock=vc)
+        svc_b = SolveService.recover(journal_b, policy, clock=vc,
+                                     sleep=vc.sleep, seed=seed)
+        replay = svc_b.recovery
+        outs = {o.request_id: o for o in svc_b.drain()}
+        stats = svc_b.stats()
+        journal_b.close()
+        final = replay_journal(path)
+    return _finish("journal-crash-replay", seed, {
+        "replay_reconstructed_the_ledger": replay.submitted == 4
+        and len(replay.outcomes) == 2 and len(replay.pending) == 2
+        and replay.lost == 0,
+        "survivors_were_mid_flight_and_tainted": all(
+            pend.in_flight for pend in replay.pending)
+        and all(pend.taint == {other.request.request_id}
+                for pend, other in zip(replay.pending,
+                                       reversed(replay.pending))),
+        "survivors_recovered_and_converged": len(outs) == 2
+        and all(o.converged for o in outs.values()),
+        "recovered_counted_not_readmitted":
+            stats["recovered"] == 2 and stats["lost"] == 0
+            and _counter("serve.recovered") == 2
+            and _counter("serve.admitted") == 4,
+        "exactly_one_outcome_per_request":
+            sorted(final.outcomes) == [f"req-{i}" for i in range(4)]
+            and not final.duplicate_outcomes and not final.pending,
+    }, {"pre_crash_outcomes": 2,
+        "recovered_attempts": sorted(o.attempts for o in outs.values())})
+
+
+@scenario("journal-torn-tail")
+def _journal_torn_tail(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        SolveJournal,
+        SolveRequest,
+        SolveService,
+        replay_journal,
+    )
+
+    p = _problem()
+    with tempfile.TemporaryDirectory(prefix="poisson-torn-") as td:
+        path = os.path.join(td, "serve.journal")
+        vc = VirtualClock()
+        policy = _continuous_policy(capacity=16, max_batch=2,
+                                    refill_chunk=10)
+        journal_a = SolveJournal(path, clock=vc)
+        svc_a = SolveService(policy, clock=vc, sleep=vc.sleep,
+                             seed=seed, journal=journal_a)
+        for i in range(3):
+            svc_a.submit(SolveRequest(request_id=f"t{i}", problem=p,
+                                      rhs_gate=1.0 + i / 10))
+        svc_a.pump()                  # dispatch/splice records exist
+        journal_a.close()
+        # Bit-rot the tail: corrupt the CRC of the last record, then
+        # append a sealed-looking fake outcome with a WRONG crc and a
+        # half-written line (the crash landed mid-write). None of the
+        # three may be trusted — the fake outcome in particular must
+        # not mark t0 terminated.
+        lines = open(path).read().splitlines()
+        tampered = json.loads(lines[-1])
+        tampered["crc32"] = (tampered["crc32"] + 1) % (2 ** 32)
+        lines[-1] = json.dumps(tampered, sort_keys=True)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+            fh.write('{"kind": "outcome", "outcome": "result", '
+                     '"request_id": "t0", "seq": 999, "t": 9.9, '
+                     '"crc32": 12345}\n')
+            fh.write('{"seq": 1000, "ki')        # torn mid-write
+        journal_b = SolveJournal(path, clock=vc)
+        svc_b = SolveService.recover(journal_b, policy, clock=vc,
+                                     sleep=vc.sleep, seed=seed)
+        replay = svc_b.recovery
+        outs = {o.request_id: o for o in svc_b.drain()}
+        journal_b.close()
+        final = replay_journal(path)
+    return _finish("journal-torn-tail", seed, {
+        "torn_records_skipped_audibly": replay.torn_records == 3
+        and _counter("serve.journal.torn_records") >= 3
+        and len(replay.torn_detail) == 3,
+        "fake_outcome_not_trusted": not replay.outcomes
+        and len(replay.pending) == 3,
+        "all_recovered_and_converged": len(outs) == 3
+        and all(o.converged for o in outs.values()),
+        "ledger_closed_despite_corruption":
+            sorted(o for o in final.outcomes
+                   if not final.duplicate_outcomes)
+            == [f"t{i}" for i in range(3)],
+    }, {"torn_detail": replay.torn_detail})
+
+
+@scenario("crash-restart-subprocess")
+def _crash_restart_subprocess(seed: int) -> dict:
+    """The acceptance-criteria drill: kill ``python -m poisson_tpu
+    serve`` mid-run (exit 75 after two outcomes, telemetry flushed,
+    queue and lanes abandoned), restart it against the journal, and
+    assert the ledger invariant ACROSS the kill/replay boundary from the
+    two emitted serve.* snapshots — zero lost, zero duplicated."""
+    import subprocess
+    import sys
+
+    from poisson_tpu.serve.journal import replay_journal
+
+    with tempfile.TemporaryDirectory(prefix="poisson-crash-") as td:
+        journal = os.path.join(td, "serve.journal")
+        a_metrics = os.path.join(td, "metrics-a.json")
+        b_metrics = os.path.join(td, "metrics-b.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        base = [sys.executable, "-m", "poisson_tpu", "serve", "40", "40",
+                "--continuous", "--refill-chunk", "10",
+                "--max-batch", "2", "--journal", journal,
+                "--seed", str(seed)]
+        phase_a = subprocess.run(
+            base + ["--requests", "6", "--kill-after", "2",
+                    "--metrics-out", a_metrics],
+            capture_output=True, text=True, timeout=240, env=env)
+        phase_b = subprocess.run(
+            base + ["--requests", "0", "--recover", "--json",
+                    "--metrics-out", b_metrics],
+            capture_output=True, text=True, timeout=240, env=env)
+
+        def counters(path):
+            try:
+                with open(path) as fh:
+                    return json.load(fh).get("counters", {})
+            except (OSError, ValueError):
+                return {}
+
+        ca, cb = counters(a_metrics), counters(b_metrics)
+
+        def terminated(c):
+            return (c.get("serve.completed", 0) + c.get("serve.errors", 0)
+                    + c.get("serve.shed", 0))
+
+        admitted = ca.get("serve.admitted", 0) + cb.get("serve.admitted", 0)
+        done = terminated(ca) + terminated(cb)
+        final = replay_journal(journal)
+        detail = {
+            "phase_a_rc": phase_a.returncode,
+            "phase_b_rc": phase_b.returncode,
+            "admitted": admitted, "terminated": done,
+            "terminated_before_kill": terminated(ca),
+            "recovered": cb.get("serve.recovered", 0),
+            "stderr_tail_a": phase_a.stderr.strip()[-300:],
+            "stderr_tail_b": phase_b.stderr.strip()[-300:],
+        }
+    return _finish("crash-restart-subprocess", seed, {
+        "phase_a_died_mid_run": phase_a.returncode == 75
+        and terminated(ca) < 6,
+        "phase_b_recovered_cleanly": phase_b.returncode == 0,
+        "invariant_closes_across_restart": admitted == 6
+        and admitted - done == 0,
+        "zero_lost": sorted(final.outcomes) == [str(i) for i in range(6)]
+        and not final.pending,
+        "zero_duplicated": not final.duplicate_outcomes,
+        "recovery_balanced_the_deficit":
+            cb.get("serve.recovered", 0) == 6 - terminated(ca),
+    }, detail)
+
+
+@scenario("dedup-idempotent-submit")
+def _dedup_idempotent_submit(seed: int) -> dict:
+    from poisson_tpu.serve import (
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(capacity=8, dedup=True,
+                      degradation=_quiet_degradation()),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    p = _problem()
+    svc.submit(SolveRequest(request_id="once", problem=p))
+    dup_pending = svc.submit(SolveRequest(request_id="once", problem=p))
+    (out,) = svc.drain()
+    dup_done = svc.submit(SolveRequest(request_id="once", problem=p))
+    return _finish("dedup-idempotent-submit", seed, {
+        "pending_duplicate_not_readmitted": dup_pending is None,
+        "done_duplicate_returns_original": dup_done is out
+        and dup_done.converged,
+        "dedup_hits_counted": _counter("serve.dedup.hits") == 2,
+        "admitted_exactly_once": _counter("serve.admitted") == 1,
+    }, {"outcome_kind": out.kind})
 
 
 # -- campaign runner ----------------------------------------------------

@@ -187,3 +187,82 @@ def compose_faults(*faults):
             f(requests, attempts)
 
     return fault
+
+
+# -- fleet-level faults (poisson_tpu.serve worker seam) -----------------
+
+
+def worker_kill_fault(worker_ids, kills_per_worker: int = 1):
+    """A *worker-kill* injector for the service's ``worker_fault`` seam
+    (called as ``(worker_id, requests, attempts)``): the named workers
+    die with :class:`~poisson_tpu.serve.fleet.WorkerCrashError` on their
+    first ``kills_per_worker`` dispatches — the model of a preempted or
+    OOM-killed execution engine. The supervisor must quarantine the
+    worker, recover its in-flight requests onto the survivors with
+    mutual taint, and restart it through warm-up."""
+    targets = set(worker_ids)
+    kills: dict = {}
+
+    def fault(worker_id, requests, attempts):
+        if worker_id in targets and kills.get(worker_id, 0) < kills_per_worker:
+            kills[worker_id] = kills.get(worker_id, 0) + 1
+            from poisson_tpu.serve.fleet import WorkerCrashError
+
+            raise WorkerCrashError(
+                f"injected kill of worker {worker_id} "
+                f"(kill {kills[worker_id]}/{kills_per_worker}, "
+                f"{len(requests)} request(s) in flight)"
+            )
+
+    return fault
+
+
+def worker_hang_fault(worker_ids, stall_seconds: float, advance,
+                      hangs_per_worker: int = 1):
+    """A *worker-hang* injector: the named workers wedge mid-dispatch
+    for ``stall_seconds`` on the injected clock (``advance`` — a
+    ``VirtualClock.advance`` in chaos scenarios) and then surface
+    :class:`~poisson_tpu.serve.fleet.WorkerHangError`. Sized past the
+    fleet's heartbeat timeout, the stall verdict must land on the
+    worker's watchdog (``watchdog.stalls``) before the supervisor
+    quarantines and recovers."""
+    targets = set(worker_ids)
+    hangs: dict = {}
+
+    def fault(worker_id, requests, attempts):
+        if worker_id in targets and hangs.get(worker_id, 0) < hangs_per_worker:
+            hangs[worker_id] = hangs.get(worker_id, 0) + 1
+            advance(stall_seconds)
+            from poisson_tpu.serve.fleet import WorkerHangError
+
+            raise WorkerHangError(
+                f"worker {worker_id} wedged for {stall_seconds}s "
+                f"mid-dispatch (hang {hangs[worker_id]})"
+            )
+
+    return fault
+
+
+def kill_worker_at(at_seconds: float, clock, kills: int = 1):
+    """Bench-churn injector (``bench.py --serve --workers W
+    --kill-worker-at T``): once ``clock()`` passes ``at_seconds``, the
+    next ``kills`` dispatching workers die — worker churn at a
+    wall-clock point in an open-loop run, whichever worker happens to
+    hold the dispatch."""
+    state = {"kills": 0}
+
+    def fault(worker_id, requests, attempts):
+        if state["kills"] < kills and clock() >= at_seconds:
+            state["kills"] += 1
+            from poisson_tpu.serve.fleet import WorkerCrashError
+
+            raise WorkerCrashError(
+                f"injected churn: worker {worker_id} killed at "
+                f"t={clock():.3f}s (kill {state['kills']}/{kills})"
+            )
+
+    # Callers (bench.py fleet mode) read this to tell a churned run
+    # from one that finished before the kill was due — the record must
+    # never label clean throughput as a churn experiment.
+    fault.state = state
+    return fault

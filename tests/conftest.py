@@ -77,6 +77,14 @@ def pytest_configure(config):
         "accounting/burn rates/histogram exposition, the trace CLI; "
         "CPU-fast; runs in tier-1, selectable with -m flight)",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: durable solve fleet suite (supervised workers — "
+        "kill/hang/quarantine/restart, CRC-sealed request journal, "
+        "torn-tail replay, crash-restart recovery preserving the "
+        "ledger invariant; CPU-fast; runs in tier-1, selectable with "
+        "-m fleet)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
