@@ -40,7 +40,11 @@ judges another. Fleet records (``--serve R --workers W``) carry
 ``detail.workers`` in the cohort key too: a W-worker fleet under churn
 is a different experiment from the single-worker service, and its
 sustained throughput is never compared against single-worker baselines
-(direction-pinned by tests/test_fleet.py).
+(direction-pinned by tests/test_fleet.py). Mixed-geometry records
+(``--serve R --geometry-mix K``) carry ``detail.geometry_mix`` in the
+cohort key: a K-family mixed load solves K different operators per
+bucket, so its sustained number never judges a single-ellipse baseline
+(pinned by tests/test_geometry_dsl.py).
 
 Stdlib only, no jax import: like the forensics renderer, a post-session
 gate must never risk initializing a backend.
@@ -86,6 +90,7 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                fault_load: Optional[str] = None,
                arrival_rate: Optional[float] = None,
                workers: Optional[int] = None,
+               geometry_mix: Optional[int] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -111,6 +116,10 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # count is experiment identity — multi-worker churn throughput
         # never judges single-worker baselines. Cohort key too.
         "workers": workers,
+        # Mixed-geometry records (bench.py --serve --geometry-mix K):
+        # the family count is experiment identity — a K-domain mixed
+        # load never judges a single-ellipse baseline. Cohort key too.
+        "geometry_mix": geometry_mix,
         "failed": bool(failed),
         "note": note,
     }
@@ -145,6 +154,7 @@ def record_from_result(result: dict, source: str,
         fault_load=det.get("fault_load"),
         arrival_rate=det.get("arrival_rate"),
         workers=det.get("workers"),
+        geometry_mix=det.get("geometry_mix"),
     )
 
 
@@ -234,14 +244,17 @@ def cohort_key(rec: dict):
     """Records are only ever compared inside this key: same metric, same
     grid, same dtype, same platform/backend/device-count — and, for
     service-mode records, the same injected fault load, the same
-    open-loop arrival rate, AND the same fleet worker count (fault-load
-    runs are never judged against clean baselines; throughput at one
-    offered load is a different experiment from another; a W-worker
-    fleet never judges a single-worker baseline)."""
+    open-loop arrival rate, the same fleet worker count, AND the same
+    geometry-mix family count (fault-load runs are never judged against
+    clean baselines; throughput at one offered load is a different
+    experiment from another; a W-worker fleet never judges a
+    single-worker baseline; a K-family mixed-geometry load never judges
+    a single-ellipse one)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
             rec.get("devices"), rec.get("fault_load"),
-            rec.get("arrival_rate"), rec.get("workers"))
+            rec.get("arrival_rate"), rec.get("workers"),
+            rec.get("geometry_mix"))
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

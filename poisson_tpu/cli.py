@@ -33,6 +33,14 @@ timeline and latency decomposition, read from the JSONL event log):
 
     python -m poisson_tpu trace REQUEST_ID --telemetry DIR [--json]
 
+plus geometry-as-a-request (``poisson_tpu.geometry`` — README "Geometry
+requests"): ``--geometry SPEC`` (inline JSON or ``@file.json``) on
+``solve``, ``solve-batched`` (repeatable: members round-robin across the
+specs and co-batch in one bucket executable), and ``serve``; and a spec
+debugger:
+
+    python -m poisson_tpu geometry SPEC [--M 64 --N 64] [--render|--json]
+
 Both entry points honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the JAX
 persistent compilation cache, ``utils.compile_cache``): traced programs
 persist across processes, and cache hits/misses land in the metrics
@@ -61,6 +69,25 @@ import numpy as np
 from poisson_tpu.config import Problem
 from poisson_tpu.utils.platform import honor_jax_platforms_env
 from poisson_tpu.utils.timing import PhaseTimer, fence, solve_report
+
+
+def _parse_geometry_arg(spec: str):
+    """A ``--geometry`` value — inline JSON or ``@file.json`` — to a
+    normalized spec. Called AFTER parse_args (the parser stays
+    jax-import-free); errors exit like every other flag validation."""
+    label = spec if len(spec) < 60 else spec[:57] + "..."
+    if spec.startswith("@"):
+        try:
+            with open(spec[1:]) as f:
+                spec = f.read()
+        except OSError as e:
+            raise SystemExit(f"--geometry {label}: {e}")
+    from poisson_tpu.geometry import parse_geometry
+
+    try:
+        return parse_geometry(spec)
+    except ValueError as e:
+        raise SystemExit(f"--geometry {label}: {e}")
 
 
 def _parse_mesh(spec: str) -> tuple[int, int]:
@@ -137,6 +164,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stage0's unweighted convergence norm")
     p.add_argument("--repeat", type=int, default=1,
                    help="timed solve repetitions; report the best")
+    p.add_argument("--geometry", metavar="SPEC", default=None,
+                   help="solve this domain instead of the reference "
+                        "ellipse: a geometry-DSL JSON spec inline or "
+                        "@file.json (poisson_tpu.geometry; single-device "
+                        "xla backend). Preview specs with `python -m "
+                        "poisson_tpu geometry SPEC`")
     p.add_argument("--checkpoint", metavar="PATH", default=None,
                    help="persist solver state to PATH every --chunk "
                         "iterations and resume from it (every JAX backend; "
@@ -270,6 +303,11 @@ def _pick_backend(args) -> str:
     if args.resilient:
         # --resilient drives the single-device xla recovery driver; auto
         # must not outsmart it onto a backend that would then reject it.
+        return "xla"
+    if getattr(args, "geometry", None):
+        # --geometry likewise: the geometry canvases ride the
+        # single-device xla solve (the pallas/sharded paths bake the
+        # reference ellipse).
         return "xla"
     devices = jax.devices()
     tpu = devices[0].platform == "tpu"
@@ -528,8 +566,10 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
     else:
         from poisson_tpu.solvers.pcg import pcg_solve
 
+        geom = (_parse_geometry_arg(args.geometry)
+                if getattr(args, "geometry", None) else None)
         run = lambda: pcg_solve(problem, dtype=args.dtype,
-                                stream_every=stream_every)
+                                stream_every=stream_every, geometry=geom)
         n_dev = 1
 
     from poisson_tpu import obs
@@ -590,7 +630,11 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
         problem, result, best,
         compile_seconds=timer.times["compile_and_first_solve"] - best,
         dtype=dtype_name, devices=n_dev, mesh=mesh_shape,
-        l2_error=l2_error_host(problem, result.w),
+        # The analytic L2 control is the ELLIPSE oracle; a custom
+        # geometry has its own manufactured-solution gate
+        # (geometry.manufactured) and reports no ellipse error.
+        l2_error=(None if getattr(args, "geometry", None)
+                  else l2_error_host(problem, result.w)),
         backend=backend,
         device_kind=getattr(devices[0], "device_kind", None),
     )
@@ -668,6 +712,12 @@ def build_batched_parser() -> argparse.ArgumentParser:
                    help="give each member a distinct RHS magnitude "
                         "(gate 1+i/B) so members converge at different "
                         "iterations and the per-member masking is visible")
+    p.add_argument("--geometry", metavar="SPEC", action="append",
+                   default=None,
+                   help="geometry-DSL JSON (inline or @file.json); "
+                        "repeatable — members round-robin across the "
+                        "specs and DIFFERENT geometries co-batch in the "
+                        "one bucket executable (poisson_tpu.geometry)")
     p.add_argument("--repeat", type=int, default=1,
                    help="timed batched-solve repetitions; report the best")
     p.add_argument("--compare-sequential", action="store_true",
@@ -723,8 +773,14 @@ def _main_solve_batched(argv) -> int:
 
     obs_profile.configure_from_env()
 
+    geometries = None
+    if args.geometry:
+        specs = [_parse_geometry_arg(s) for s in args.geometry]
+        geometries = [specs[i % len(specs)] for i in range(B)]
+
     run = lambda: solve_batched(problem, rhs_gates=gates,
-                                dtype=args.dtype, bucket=args.bucket)
+                                dtype=args.dtype, bucket=args.bucket,
+                                geometries=geometries)
     timer = PhaseTimer()
     with timer.phase("compile_and_first_solve"):
         result = run()
@@ -753,15 +809,20 @@ def _main_solve_batched(argv) -> int:
         "converged": converged,
         "flags": sorted({FLAG_NAMES.get(f, str(f)) for f in flags}),
     }
+    if geometries is not None:
+        record["geometry_mix"] = len(args.geometry)
+        record["geometries"] = sorted({g.fingerprint for g in geometries})
 
     if args.compare_sequential:
-        seq = lambda g: pcg_solve(problem, dtype=args.dtype, rhs_gate=g)
-        fence(seq(gates[0]))           # compile once outside the timing
+        geos = geometries or [None] * B
+        seq = lambda g, geo: pcg_solve(problem, dtype=args.dtype,
+                                       rhs_gate=g, geometry=geo)
+        fence(seq(gates[0], geos[0]))  # compile once outside the timing
         with obs.span("timed_sequential_solves", fence=False, batch=B):
             t0 = time.perf_counter()
             seq_iters = []
-            for g in gates:
-                r = seq(g)
+            for g, geo in zip(gates, geos):
+                r = seq(g, geo)
                 fence(r.iterations)    # serialize: no cross-solve overlap
                 seq_iters.append(int(r.iterations))
             seq_seconds = time.perf_counter() - t0
@@ -829,6 +890,13 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "else float32)")
     p.add_argument("--vary-rhs", action="store_true",
                    help="give each request a distinct RHS magnitude")
+    p.add_argument("--geometry", metavar="SPEC", action="append",
+                   default=None,
+                   help="geometry-DSL JSON (inline or @file.json); "
+                        "repeatable — requests round-robin across the "
+                        "specs, forming a mixed-geometry load whose "
+                        "families co-batch per bucket executable "
+                        "(fingerprints ride the flight traces)")
     p.add_argument("--continuous", action="store_true",
                    help="continuous-batching scheduling: a lane table "
                         "steps the fused program chunk by chunk, "
@@ -964,6 +1032,8 @@ def _main_serve(argv) -> int:
     else:
         svc = SolveService(policy, seed=args.seed, dispatch_fault=fault,
                            worker_fault=worker_fault, journal=journal)
+    geo_specs = ([_parse_geometry_arg(s) for s in args.geometry]
+                 if args.geometry else None)
     rng = _random.Random(args.seed)
     t0 = time.perf_counter()
     for i in range(args.requests):
@@ -972,6 +1042,8 @@ def _main_serve(argv) -> int:
             rhs_gate=(1.0 + rng.random() if args.vary_rhs else 1.0),
             dtype=args.dtype, deadline_seconds=args.deadline,
             chunk=args.chunk,
+            geometry=(geo_specs[i % len(geo_specs)] if geo_specs
+                      else None),
         ))
     if args.kill_after is not None:
         # The crash half of the journal drill: once K outcomes exist,
@@ -999,6 +1071,9 @@ def _main_serve(argv) -> int:
         "M": problem.M, "N": problem.N, "requests": args.requests,
         "scheduling": svc.policy.scheduling,
         "workers": args.workers,
+        **({"geometry_mix": len(geo_specs),
+            "geometries": sorted({g.fingerprint for g in geo_specs})}
+           if geo_specs else {}),
         "wall_seconds": round(wall, 4),
         "throughput_rps": round(stats["completed"] / wall, 2) if wall
         else None,
@@ -1116,6 +1191,70 @@ def _main_trace(argv) -> int:
         print("INCOMPLETE TRACE: " + "; ".join(problems),
               file=sys.stderr)
         return 1
+    return 0
+
+
+def build_geometry_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m poisson_tpu geometry",
+        description="Geometry-spec debugger (poisson_tpu.geometry): "
+                    "parse a DSL spec, print its fingerprint and "
+                    "canonical form, compile its blend-coefficient "
+                    "canvases, and preview the domain as ASCII "
+                    "('#' inside, '+' cut faces, '.' outside).",
+    )
+    p.add_argument("spec", metavar="SPEC",
+                   help="geometry-DSL JSON, inline or @file.json "
+                        "(README \"Geometry requests\" has the grammar)")
+    p.add_argument("--M", type=int, default=64,
+                   help="grid cells in x for the canvas preview "
+                        "(default 64)")
+    p.add_argument("--N", type=int, default=64,
+                   help="grid cells in y (default 64)")
+    p.add_argument("--render", action="store_true",
+                   help="ASCII canvas preview (default unless --json)")
+    p.add_argument("--width", type=int, default=64,
+                   help="render columns (default 64)")
+    p.add_argument("--height", type=int, default=24,
+                   help="render rows (default 24)")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line (fingerprint, canonical spec, "
+                        "canvas stats) instead of the render")
+    return p
+
+
+def _main_geometry(argv) -> int:
+    args = build_geometry_parser().parse_args(argv)
+    honor_jax_platforms_env()
+    import numpy as _np
+
+    from poisson_tpu.geometry import (build_geometry_fields,
+                                      cut_face_mask, render_ascii)
+
+    spec = _parse_geometry_arg(args.spec)
+    problem = Problem(M=args.M, N=args.N)
+    a64, b64, rhs64 = build_geometry_fields(problem, spec)
+    cut = int(cut_face_mask(a64, b64, problem.eps).sum())
+    stats = {
+        "fingerprint": spec.fingerprint,
+        "spec": json.loads(spec.to_json()),
+        "M": problem.M, "N": problem.N,
+        "inside_nodes": int((rhs64 != 0).sum()),
+        "inside_fraction": round(float((rhs64 != 0).mean()), 4),
+        "cut_faces": cut,
+        "coeff_range": [float(_np.min([a64.min(), b64.min()])),
+                        float(_np.max([a64.max(), b64.max()]))],
+    }
+    if args.json:
+        print(json.dumps(stats))
+        return 0
+    print(f"fingerprint: {stats['fingerprint']}")
+    print(f"canonical:   {spec.to_json()}")
+    print(f"grid {problem.M}x{problem.N}: "
+          f"{stats['inside_nodes']} nodes inside "
+          f"({stats['inside_fraction']:.1%}), {cut} cut faces")
+    print(render_ascii(problem, spec, width=args.width,
+                       height=args.height))
     return 0
 
 
@@ -1251,6 +1390,8 @@ def main(argv=None) -> int:
         return _main_chaos(argv[1:])
     if argv and argv[0] == "trace":
         return _main_trace(argv[1:])
+    if argv and argv[0] == "geometry":
+        return _main_geometry(argv[1:])
     args = build_parser().parse_args(argv)
     # Reconcile the positional and flag grid forms: exactly one per axis.
     for axis in ("M", "N"):
@@ -1322,6 +1463,11 @@ def main(argv=None) -> int:
             "the resilience/fault-injection flags drive the JAX chunked "
             "solvers; not available with --backend native"
         )
+    if args.geometry is not None and args.backend == "native":
+        raise SystemExit(
+            "--geometry drives the single-device xla solve; the native "
+            "C++ path bakes the reference ellipse"
+        )
 
     if args.dtype == "float64" and args.backend != "native":
         import jax
@@ -1378,6 +1524,20 @@ def main(argv=None) -> int:
                 raise SystemExit(
                     "--serial-reduce accumulates across sequential grid "
                     "steps; it cannot be combined with --parallel-grid"
+                )
+        if args.geometry is not None:
+            if backend != "xla":
+                raise SystemExit(
+                    f"--geometry drives the single-device xla solve "
+                    f"(resolved backend: {backend}); the pallas/sharded/"
+                    f"native paths bake the reference ellipse"
+                )
+            if args.resilient or args.checkpoint:
+                raise SystemExit(
+                    "--geometry rides the plain xla solve; the "
+                    "checkpointed/resilient CLI drivers are ellipse-only "
+                    "(geometry-aware chunked dispatch lives in the solve "
+                    "service: python -m poisson_tpu serve --geometry)"
                 )
         if args.resilient and backend != "xla":
             raise SystemExit(

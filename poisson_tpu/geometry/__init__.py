@@ -1,0 +1,51 @@
+"""Geometry as a request: shape DSL + canvas compiler + fingerprint cache.
+
+Public surface:
+
+- the spec algebra (:mod:`geometry.dsl`): :class:`Ellipse`,
+  :class:`Rectangle`, :class:`Polygon`, :class:`Union`,
+  :class:`Intersection`, :class:`Difference`, :class:`SDF`,
+  :data:`DEFAULT_ELLIPSE`, :func:`parse_geometry`,
+  :func:`fingerprint_of`;
+- the canvas compiler and cache (:mod:`geometry.canvas`):
+  :func:`geometry_setup` (device canvases, ``geom.cache.{hits,misses}``
+  keyed by fingerprint), :func:`build_geometry_fields` (host fp64),
+  :func:`render_ascii`, :func:`reset_geometry_cache`;
+- the accuracy gate (:mod:`geometry.manufactured`): one
+  manufactured-solution oracle per family, the same L2-at-the-floor
+  rule BENCH.md applies to the ellipse.
+
+See README "Geometry requests" for the JSON grammar and the
+co-batching semantics (different geometries on the same grid share one
+bucket executable — only the canvases differ per member).
+"""
+
+from poisson_tpu.geometry.canvas import (
+    build_geometry_fields,
+    cut_face_mask,
+    geometry_face_lengths,
+    geometry_setup,
+    render_ascii,
+    reset_geometry_cache,
+)
+from poisson_tpu.geometry.dsl import (
+    DEFAULT_ELLIPSE,
+    Difference,
+    Ellipse,
+    GeometrySpec,
+    Intersection,
+    Polygon,
+    Rectangle,
+    SDF,
+    Union,
+    fingerprint_of,
+    parse_geometry,
+)
+
+__all__ = [
+    "GeometrySpec", "Ellipse", "Rectangle", "Polygon", "Union",
+    "Intersection", "Difference", "SDF", "DEFAULT_ELLIPSE",
+    "parse_geometry", "fingerprint_of", "geometry_setup",
+    "build_geometry_fields", "cut_face_mask", "geometry_face_lengths",
+    "render_ascii", "reset_geometry_cache",
+]

@@ -1,0 +1,357 @@
+"""Canvas compiler: GeometrySpec → fictitious-domain coefficient fields.
+
+The solver never sees a geometry — it sees the blend-coefficient
+canvases ``a``, ``b`` and the RHS indicator that
+``models.fictitious_domain`` bakes for the reference ellipse. This
+module generalises that bake to any :mod:`geometry.dsl` spec, with the
+SAME face-intersection blend rule (``_blend``: full face → 1, empty
+face → 1/ε, cut face → ℓ/h + (1−ℓ/h)/ε with ε = max(h1,h2)²):
+
+- **exact closed-form segment lengths** where the face ∩ domain
+  intersection has one (:class:`~poisson_tpu.geometry.dsl.Ellipse` —
+  the reference's own formula generalised to (cx, cy, rx, ry), bit-
+  compatible with ``fictitious_domain`` for the default spec — and
+  :class:`~poisson_tpu.geometry.dsl.Rectangle`);
+- **adaptive face sampling of the level set** everywhere else
+  (polygons, boolean composites, raw SDFs): each face is probed at
+  ``samples+1`` uniform points, fully-inside subintervals are counted
+  exactly, and every sign-changing subinterval is refined by vectorised
+  bisection of the spec's continuous ``sdf`` down to ~h·2⁻⁴⁴ — so the
+  sampled ℓ is exact up to features narrower than h/samples.
+
+Like the reference (and ``solvers.pcg.host_fields64``), canvases are
+built on the host in numpy fp64 and cast once; they are **never stored
+below fp32** — bf16 coefficient storage was measured and rejected for
+exactly these canvases (BENCH.md "Precision of the coefficient
+canvases").
+
+The **canvas cache** is keyed by ``(geometry fingerprint, grid box,
+f_val, dtype, scaled)`` — the same discipline as the jit cache's static
+shape key, with the fingerprint standing in for the canvas *content* —
+and surfaces its traffic as ``geom.cache.{hits,misses}``: a mixed-
+geometry serving load that re-uses K families shows hits ≫ misses, and
+a second family landing on an already-compiled bucket executable is
+visible as a ``geom.cache.miss`` + ``batched.bucket_cache.hit`` pair
+(new canvases, no recompile).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+from poisson_tpu import obs
+from poisson_tpu.config import Problem
+from poisson_tpu.geometry.dsl import (
+    DEFAULT_ELLIPSE,
+    Ellipse,
+    GeometrySpec,
+    Rectangle,
+    parse_geometry,
+)
+from poisson_tpu.models.fictitious_domain import _blend
+
+# Face-sampling defaults: 16 uniform probes classify each face, 44
+# bisection steps pin every boundary crossing to ~h·2e-14. Canvases are
+# built once per fingerprint and cached, so this cost is off the solve
+# path entirely.
+DEFAULT_SAMPLES = 16
+DEFAULT_BISECT_ITERS = 44
+
+_CACHE_CAP = 64
+_CACHE: "OrderedDict" = OrderedDict()
+
+
+def reset_geometry_cache() -> None:
+    """Forget every cached canvas (tests; pair with
+    ``obs.metrics.reset()`` — the ``geom.cache.*`` counters and this
+    cache must move together or hit/miss arithmetic goes stale)."""
+    _CACHE.clear()
+
+
+def _ellipse_lengths(spec: Ellipse, const, start, end, vertical, xp):
+    """Closed-form face ∩ ellipse length — the reference's
+    ``cal_seg_len_in_D`` generalised to (cx, cy, rx, ry). For the
+    default spec every operation reduces to the reference's expression
+    under exact power-of-two float scalings, so the result is
+    bit-identical to ``fictitious_domain.segment_length_in_domain``
+    (asserted in tests).
+
+    The half-width uses the double-where guard instead of a bare
+    ``sqrt(max(0, v))``: values are identical (sqrt(0)=0 either way) but
+    the derivative at v ≤ 0 becomes 0 instead of 0·inf = NaN — required
+    by the traced shape-gradient path (``solvers.adjoint``)."""
+
+    def _half(v, r):
+        pos = v > 0.0
+        return r * xp.where(pos, xp.sqrt(xp.where(pos, v, 1.0)), 0.0)
+
+    if vertical:
+        t = (const - spec.cx) / spec.rx
+        half = _half(1.0 - t * t, spec.ry)
+        lo, hi = spec.cy - half, spec.cy + half
+    else:
+        t = (const - spec.cy) / spec.ry
+        half = _half(1.0 - t * t, spec.rx)
+        lo, hi = spec.cx - half, spec.cx + half
+    return xp.maximum(0.0, xp.minimum(end, hi) - xp.maximum(start, lo))
+
+
+def _rectangle_lengths(spec: Rectangle, const, start, end, vertical, xp):
+    """Closed-form face ∩ box length: interval clip, gated on the fixed
+    coordinate lying strictly inside the box's other extent."""
+    if vertical:
+        inside = (const > spec.x0) & (const < spec.x1)
+        lo, hi = spec.y0, spec.y1
+    else:
+        inside = (const > spec.y0) & (const < spec.y1)
+        lo, hi = spec.x0, spec.x1
+    clip = xp.maximum(0.0, xp.minimum(end, hi) - xp.maximum(start, lo))
+    return xp.where(inside, clip, xp.zeros_like(clip))
+
+
+def closed_form_lengths(spec: GeometrySpec, const, start, end,
+                        vertical: bool, xp):
+    """Exact segment length for specs that have one, else None."""
+    if isinstance(spec, Ellipse):
+        return _ellipse_lengths(spec, const, start, end, vertical, xp)
+    if isinstance(spec, Rectangle):
+        return _rectangle_lengths(spec, const, start, end, vertical, xp)
+    return None
+
+
+def _sampled_lengths(sdf_line: Callable, const_flat, start_flat,
+                     h: float, samples: int, iters: int):
+    """Adaptive face sampling: probe each face uniformly, count the
+    fully-inside subintervals, bisect every sign change.
+
+    ``sdf_line(c, t)`` evaluates the spec's level set along the face
+    family (c = the fixed coordinate, t = the running one), vectorised
+    over same-shape arrays. Misses only features narrower than
+    h/samples — sub-probe tunnels through a face, which at solve
+    resolution means geometry the grid could not represent anyway.
+    """
+    n = const_flat.size
+    dt = h / samples
+    ts = start_flat[:, None] + dt * np.arange(samples + 1)[None, :]
+    F = sdf_line(np.broadcast_to(const_flat[:, None], ts.shape), ts)
+    inside = F < 0.0
+    li, ri = inside[:, :-1], inside[:, 1:]
+    lengths = (li & ri).sum(axis=1) * dt
+    cross = li != ri
+    if cross.any():
+        fi, si = np.nonzero(cross)
+        lo = ts[fi, si].astype(float)
+        hi = ts[fi, si + 1].astype(float)
+        c = const_flat[fi]
+        lo_inside = li[fi, si]
+        for _ in range(iters):
+            mid = 0.5 * (lo + hi)
+            mid_inside = sdf_line(c, mid) < 0.0
+            take_lo = mid_inside == lo_inside
+            lo = np.where(take_lo, mid, lo)
+            hi = np.where(take_lo, hi, mid)
+        crossing = 0.5 * (lo + hi)
+        contrib = np.where(lo_inside, crossing - ts[fi, si],
+                           ts[fi, si + 1] - crossing)
+        np.add.at(lengths, fi, contrib)
+    return lengths
+
+
+def geometry_face_lengths(problem: Problem, spec: GeometrySpec,
+                          samples: int = DEFAULT_SAMPLES,
+                          bisect_iters: int = DEFAULT_BISECT_ITERS):
+    """Face-intersection lengths (la, lb) on the full (M+1, N+1) grid,
+    numpy fp64. ``la[i,j]`` is the vertical face at
+    (x_i − h1/2, [y_j − h2/2, y_j + h2/2]); ``lb`` the horizontal one —
+    the same face convention as ``fictitious_domain.coefficient_fields``."""
+    h1, h2 = problem.h1, problem.h2
+    i_idx = np.arange(problem.M + 1)
+    j_idx = np.arange(problem.N + 1)
+    x = (problem.x_min + i_idx.astype(np.float64) * h1)[:, None]
+    y = (problem.y_min + j_idx.astype(np.float64) * h2)[None, :]
+
+    la = closed_form_lengths(spec, x - 0.5 * h1, y - 0.5 * h2,
+                             y + 0.5 * h2, True, np)
+    lb = closed_form_lengths(spec, y - 0.5 * h2, x - 0.5 * h1,
+                             x + 0.5 * h1, False, np)
+    shape = (problem.M + 1, problem.N + 1)
+    if la is None:
+        const = np.broadcast_to(x - 0.5 * h1, shape).ravel()
+        start = np.broadcast_to(y - 0.5 * h2, shape).ravel()
+        la = _sampled_lengths(
+            lambda c, t: spec.sdf(c, t, np), const, start, h2,
+            samples, bisect_iters).reshape(shape)
+    else:
+        la = np.broadcast_to(la, shape)
+    if lb is None:
+        const = np.broadcast_to(y - 0.5 * h2, shape).ravel()
+        start = np.broadcast_to(x - 0.5 * h1, shape).ravel()
+        lb = _sampled_lengths(
+            lambda c, t: spec.sdf(t, c, np), const, start, h1,
+            samples, bisect_iters).reshape(shape)
+    else:
+        lb = np.broadcast_to(lb, shape)
+    return np.asarray(la, np.float64), np.asarray(lb, np.float64)
+
+
+def build_geometry_fields(problem: Problem, spec: GeometrySpec,
+                          rhs_fn: Optional[Callable] = None,
+                          samples: int = DEFAULT_SAMPLES,
+                          bisect_iters: int = DEFAULT_BISECT_ITERS):
+    """Full-grid (a, b, B) for ``spec`` — the geometry-general
+    ``fictitious_domain.build_fields``, host numpy fp64.
+
+    ``rhs_fn(x, y) -> f`` overrides the constant ``problem.f_val``
+    forcing (the manufactured-solution gate needs non-constant f); the
+    indicator and interior masks apply either way.
+    """
+    spec = parse_geometry(spec)
+    h1, h2, eps = problem.h1, problem.h2, problem.eps
+    la, lb = geometry_face_lengths(problem, spec, samples, bisect_iters)
+    a = _blend(la, h2, eps, np).astype(np.float64)
+    b = _blend(lb, h1, eps, np).astype(np.float64)
+
+    i_idx = np.arange(problem.M + 1)
+    j_idx = np.arange(problem.N + 1)
+    x = (problem.x_min + i_idx.astype(np.float64) * h1)[:, None]
+    y = (problem.y_min + j_idx.astype(np.float64) * h2)[None, :]
+    inside = spec.contains(x, y, np)
+    interior = ((i_idx >= 1) & (i_idx <= problem.M - 1))[:, None] & (
+        (j_idx >= 1) & (j_idx <= problem.N - 1))[None, :]
+    f = (np.float64(problem.f_val) if rhs_fn is None
+         else np.asarray(rhs_fn(x, y), np.float64))
+    rhs = np.where(inside & interior, f, np.float64(0.0))
+    return a, b, rhs
+
+
+def _fields64(problem: Problem, spec: GeometrySpec, scaled: bool):
+    """(a, b, rhs_use, aux) fp64 numpy — the geometry-general
+    ``solvers.pcg.host_fields64`` (same scaled-system derivation)."""
+    from poisson_tpu.ops.stencil import diag_D
+
+    a64, b64, rhs64 = build_geometry_fields(problem, spec)
+    d64 = diag_D(a64, b64, problem.h1, problem.h2)
+    if not scaled:
+        return a64, b64, rhs64, np.pad(d64, 1)
+    inv_sqrt_d = 1.0 / np.sqrt(d64)
+    return a64, b64, np.pad(rhs64[1:-1, 1:-1] * inv_sqrt_d, 1), np.pad(
+        inv_sqrt_d, 1)
+
+
+def _canvas_key(problem: Problem) -> tuple:
+    """The Problem fields the canvases actually depend on — solver
+    knobs (delta, max_iter, weighted_norm) are normalized away so
+    requests differing only in stopping policy share canvases."""
+    return (problem.M, problem.N, problem.x_min, problem.x_max,
+            problem.y_min, problem.y_max, problem.f_val)
+
+
+def geometry_setup(problem: Problem, spec, dtype_name: str,
+                   scaled: bool):
+    """Device-resident (a, b, rhs, aux) for ``spec`` — the geometry
+    analog of ``solvers.pcg.host_setup``, fingerprint-cache-keyed.
+
+    Every call counts ``geom.cache.hits`` or ``geom.cache.misses``; a
+    miss pays the fp64 host build + cast + transfer once, after which
+    every request of the same (fingerprint, grid, dtype, scaled) —
+    including members of *different* buckets and lane splices — reuses
+    the same device arrays."""
+    import jax.numpy as jnp
+
+    spec = parse_geometry(spec)
+    key = (spec.fingerprint, _canvas_key(problem), dtype_name,
+           bool(scaled))
+    hit = _CACHE.get(key)
+    if hit is not None:
+        _CACHE.move_to_end(key)
+        obs.inc("geom.cache.hits")
+        return hit
+    obs.inc("geom.cache.misses")
+    a64, b64, rhs64, aux64 = _fields64(problem, spec, scaled)
+    dtype = jnp.dtype(dtype_name)
+    out = (jnp.asarray(a64, dtype), jnp.asarray(b64, dtype),
+           jnp.asarray(rhs64, dtype), jnp.asarray(aux64, dtype))
+    _CACHE[key] = out
+    while len(_CACHE) > _CACHE_CAP:
+        _CACHE.popitem(last=False)
+    return out
+
+
+def traced_fields(problem: Problem, spec: GeometrySpec, dtype=None):
+    """(a, b, rhs) built IN-GRAPH with jax.numpy — the differentiable
+    canvas path for shape-parameter gradients (``solvers.adjoint``).
+
+    Only the closed-form families qualify (:class:`Ellipse`,
+    :class:`Rectangle`): their face lengths are smooth functions of the
+    shape parameters wherever a face stays in its blend class, so
+    ``jax.grad`` through the ε-blend is meaningful. The sampled families
+    go through host-side bisection, whose output carries no parameter
+    derivative — asking for their gradient raises instead of silently
+    returning zeros. ``spec`` may carry traced leaves; it is used as
+    given (normalization/fingerprints need concrete floats)."""
+    import jax.numpy as jnp
+
+    if not isinstance(spec, (Ellipse, Rectangle)):
+        raise ValueError(
+            "traced_fields (shape gradients) supports the closed-form "
+            "families Ellipse and Rectangle; "
+            f"got {type(spec).__name__} — sampled canvases are built by "
+            "host-side bisection and carry no parameter derivative")
+    h1, h2, eps = problem.h1, problem.h2, problem.eps
+    dt = jnp.dtype(dtype) if dtype is not None else jnp.asarray(0.0).dtype
+    i_idx = jnp.arange(problem.M + 1)
+    j_idx = jnp.arange(problem.N + 1)
+    x = (problem.x_min + i_idx.astype(dt) * h1)[:, None]
+    y = (problem.y_min + j_idx.astype(dt) * h2)[None, :]
+    la = closed_form_lengths(spec, x - 0.5 * h1, y - 0.5 * h2,
+                             y + 0.5 * h2, True, jnp)
+    lb = closed_form_lengths(spec, y - 0.5 * h2, x - 0.5 * h1,
+                             x + 0.5 * h1, False, jnp)
+    shape = (problem.M + 1, problem.N + 1)
+    a = jnp.broadcast_to(_blend(la, h2, eps, jnp), shape).astype(dt)
+    b = jnp.broadcast_to(_blend(lb, h1, eps, jnp), shape).astype(dt)
+    inside = spec.contains(x, y, jnp)
+    interior = ((i_idx >= 1) & (i_idx <= problem.M - 1))[:, None] & (
+        (j_idx >= 1) & (j_idx <= problem.N - 1))[None, :]
+    rhs = jnp.where(inside & interior,
+                    jnp.asarray(problem.f_val, dt), jnp.zeros((), dt))
+    return a, b, jnp.broadcast_to(rhs, shape)
+
+
+def cut_face_mask(a64, b64, eps):
+    """Nodes touching a cut face: a blend coefficient strictly between
+    the full-face value (1) and the empty-face value (1/eps). Bounds are
+    relative — an absolute midpoint would drop low-coverage cut faces."""
+    hi = (1.0 / eps) * (1.0 - 1e-9)
+    return ((a64 > 1.0 + 1e-9) & (a64 < hi)) | (
+        (b64 > 1.0 + 1e-9) & (b64 < hi))
+
+
+def render_ascii(problem: Problem, spec, width: int = 64,
+                 height: int = 24) -> str:
+    """Downsampled ASCII canvas preview for spec debugging
+    (``python -m poisson_tpu geometry SPEC --render``): '#' fully
+    inside, '+' cut faces touching the node, '.' outside."""
+    spec = parse_geometry(spec)
+    a64, b64, rhs64 = build_geometry_fields(problem, spec)
+    cut = cut_face_mask(a64, b64, problem.eps)
+    inside = rhs64 != 0.0
+    rows = []
+    ii = np.linspace(0, problem.M, num=min(width, problem.M + 1),
+                     dtype=int)
+    jj = np.linspace(0, problem.N, num=min(height, problem.N + 1),
+                     dtype=int)
+    for j in jj[::-1]:                     # y up, like a plot
+        row = []
+        for i in ii:
+            if inside[i, j]:
+                row.append("#")
+            elif cut[i, j]:
+                row.append("+")
+            else:
+                row.append(".")
+        rows.append("".join(row))
+    return "\n".join(rows)

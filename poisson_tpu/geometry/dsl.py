@@ -1,0 +1,504 @@
+"""Geometry DSL: the domain as a request parameter.
+
+Every layer above the operator historically assumed the reference's one
+ellipse ``x² + 4y² < 1`` — but the fictitious-domain method never needed
+it: the domain only ever enters as the blend-coefficient canvases ``a``,
+``b`` and the RHS indicator (``models.fictitious_domain``). This module
+makes the domain a *value*: a small spec algebra
+
+    Ellipse(cx, cy, rx, ry)        — general axis-aligned ellipse
+    Rectangle(x0, y0, x1, y1)      — the axis-aligned polygon special case
+    Polygon(vertices)              — general simple polygon
+    Union(shapes) / Intersection(shapes) / Difference(shape, hole)
+    SDF(fn, name=…)                — raw signed-distance(-like) callable
+
+each of which exposes
+
+    contains(x, y, xp) — exact membership (open set; drives the RHS
+                         indicator and the inside-the-domain error mask)
+    sdf(x, y, xp)      — a continuous level-set function, negative inside,
+                         zero on the boundary (drives the adaptive face
+                         sampling in ``geometry.canvas`` — it need not be
+                         a true distance, only continuous with the right
+                         zero set)
+    normalize()        — the canonical form of the spec (flattened and
+                         fingerprint-sorted boolean children, canonical
+                         polygon start/orientation, ordered rectangle
+                         corners), so equivalent specs are *equal*
+    fingerprint        — a stable hash of the normalized spec: the key of
+                         the canvas cache (``geometry.canvas``), the
+                         co-batching taint key of the solve service
+                         (``serve.service``), and the flight-trace
+                         attribute that makes mixed-geometry buckets
+                         attributable per member
+
+The JSON grammar round-trips through :func:`parse_geometry` /
+``GeometrySpec.to_json`` (see README "Geometry requests"); ``SDF`` specs
+serialize their declared ``name`` but cannot be parsed back (a callable
+does not survive JSON — requests carrying raw SDFs are in-process only).
+
+``DEFAULT_ELLIPSE`` is exactly the reference's domain; the canvas
+compiler reproduces ``models.fictitious_domain.build_fields`` for it
+bit-for-bit (asserted in tests), so "no geometry" and "the default
+ellipse spec" are the same solve to the last ULP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Callable, Optional, Tuple
+
+__all__ = [
+    "GeometrySpec", "Ellipse", "Rectangle", "Polygon", "Union",
+    "Intersection", "Difference", "SDF", "DEFAULT_ELLIPSE",
+    "parse_geometry", "fingerprint_of",
+]
+
+
+def _np():
+    import numpy as np
+
+    return np
+
+
+def _canon_float(v) -> float:
+    """Canonical float for fingerprints: plain ``float()`` so ints,
+    numpy scalars, and floats that compare equal hash equal."""
+    return float(v)
+
+
+class GeometrySpec:
+    """Base of the spec algebra. Subclasses are frozen dataclasses —
+    hashable values, safe as dict keys and dataclass request fields."""
+
+    # -- geometry protocol (subclasses override) -----------------------
+
+    def contains(self, x, y, xp=None):
+        """Exact open-set membership, elementwise over broadcast x, y."""
+        xp = xp or _np()
+        return self.sdf(x, y, xp) < 0.0
+
+    def sdf(self, x, y, xp=None):
+        raise NotImplementedError
+
+    def normalize(self) -> "GeometrySpec":
+        return self
+
+    def to_obj(self) -> dict:
+        raise NotImplementedError
+
+    # -- derived -------------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps(self.normalize().to_obj(), sort_keys=True)
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable hash of the normalized spec — the canvas-cache key,
+        keyed the way the jit cache keys shapes: equivalent specs
+        (permuted unions, rotated polygon vertex lists) share it.
+        Memoized on the instance: the serve layer reads it per refill
+        decision (taint checks, flight attrs), and specs are frozen."""
+        fp = self.__dict__.get("_fp")
+        if fp is None:
+            digest = hashlib.sha256(self.to_json().encode()).hexdigest()
+            fp = f"g{digest[:16]}"
+            object.__setattr__(self, "_fp", fp)
+        return fp
+
+    def __str__(self) -> str:  # debugging convenience
+        return self.to_json()
+
+
+@dataclasses.dataclass(frozen=True)
+class Ellipse(GeometrySpec):
+    """Axis-aligned ellipse ((x−cx)/rx)² + ((y−cy)/ry)² < 1. The default
+    parameters are the reference's domain x² + 4y² < 1."""
+
+    cx: float = 0.0
+    cy: float = 0.0
+    rx: float = 1.0
+    ry: float = 0.5
+
+    def __post_init__(self):
+        # Concrete parameters are validated eagerly; traced leaves (the
+        # adjoint shape-gradient path, solvers.adjoint) skip the check —
+        # a tracer has no truth value.
+        if isinstance(self.rx, (int, float)) and \
+                isinstance(self.ry, (int, float)) and \
+                not (self.rx > 0 and self.ry > 0):
+            raise ValueError(f"ellipse radii must be > 0, got "
+                             f"rx={self.rx} ry={self.ry}")
+
+    def contains(self, x, y, xp=None):
+        tx = (x - self.cx) / self.rx
+        ty = (y - self.cy) / self.ry
+        return tx * tx + ty * ty < 1.0
+
+    def sdf(self, x, y, xp=None):
+        # Implicit-function level set (not a true distance): continuous,
+        # negative inside, zero exactly on the boundary — all the face
+        # sampler needs.
+        tx = (x - self.cx) / self.rx
+        ty = (y - self.cy) / self.ry
+        return tx * tx + ty * ty - 1.0
+
+    def normalize(self) -> "Ellipse":
+        return Ellipse(_canon_float(self.cx), _canon_float(self.cy),
+                       _canon_float(self.rx), _canon_float(self.ry))
+
+    def to_obj(self) -> dict:
+        return {"type": "ellipse", "cx": self.cx, "cy": self.cy,
+                "rx": self.rx, "ry": self.ry}
+
+
+DEFAULT_ELLIPSE = Ellipse()
+"""The reference's fictitious domain, as a spec."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Rectangle(GeometrySpec):
+    """Open axis-aligned box (x0, x1) × (y0, y1)."""
+
+    x0: float
+    y0: float
+    x1: float
+    y1: float
+
+    def __post_init__(self):
+        # Tracer-tolerant like Ellipse: only concrete corners validate.
+        if all(isinstance(v, (int, float)) for v in
+               (self.x0, self.y0, self.x1, self.y1)) and \
+                not (self.x1 > self.x0 and self.y1 > self.y0):
+            raise ValueError(
+                f"rectangle needs x1 > x0 and y1 > y0, got "
+                f"({self.x0},{self.y0})..({self.x1},{self.y1})")
+
+    def contains(self, x, y, xp=None):
+        return (x > self.x0) & (x < self.x1) & (y > self.y0) & (y < self.y1)
+
+    def sdf(self, x, y, xp=None):
+        xp = xp or _np()
+        cx, cy = 0.5 * (self.x0 + self.x1), 0.5 * (self.y0 + self.y1)
+        hx, hy = 0.5 * (self.x1 - self.x0), 0.5 * (self.y1 - self.y0)
+        return xp.maximum(xp.abs(x - cx) - hx, xp.abs(y - cy) - hy)
+
+    def normalize(self) -> "Rectangle":
+        x0, x1 = sorted((_canon_float(self.x0), _canon_float(self.x1)))
+        y0, y1 = sorted((_canon_float(self.y0), _canon_float(self.y1)))
+        return Rectangle(x0, y0, x1, y1)
+
+    def to_obj(self) -> dict:
+        return {"type": "rect", "x0": self.x0, "y0": self.y0,
+                "x1": self.x1, "y1": self.y1}
+
+
+@dataclasses.dataclass(frozen=True)
+class Polygon(GeometrySpec):
+    """Simple polygon (no self-intersections assumed) with vertices as a
+    tuple of (x, y) pairs. Membership is even-odd ray crossing; the level
+    set is the min-distance-to-edges with the membership sign."""
+
+    vertices: Tuple[Tuple[float, float], ...]
+
+    def __post_init__(self):
+        verts = tuple((float(x), float(y)) for x, y in self.vertices)
+        if len(verts) < 3:
+            raise ValueError(f"polygon needs >= 3 vertices, got "
+                             f"{len(verts)}")
+        object.__setattr__(self, "vertices", verts)
+
+    def _edges(self, xp):
+        v = xp.asarray(self.vertices, dtype=float)
+        return v, xp.roll(v, -1, axis=0)
+
+    def contains(self, x, y, xp=None):
+        xp = xp or _np()
+        x = xp.asarray(x, dtype=float)
+        y = xp.asarray(y, dtype=float)
+        px, py = xp.broadcast_arrays(x, y)
+        a, b = self._edges(xp)
+        # Even-odd crossing count of a +x ray, vectorized points × edges.
+        ax, ay = a[:, 0], a[:, 1]
+        bx, by = b[:, 0], b[:, 1]
+        P = px[..., None]
+        Q = py[..., None]
+        straddles = (ay <= Q) != (by <= Q)
+        # x-coordinate where the edge crosses the horizontal line y=Q.
+        t = (Q - ay) / (by - ay + (ay == by))     # guarded; masked below
+        cross_x = ax + t * (bx - ax)
+        hits = straddles & (P < cross_x)
+        return (hits.sum(axis=-1) % 2) == 1
+
+    def sdf(self, x, y, xp=None):
+        xp = xp or _np()
+        x = xp.asarray(x, dtype=float)
+        y = xp.asarray(y, dtype=float)
+        px, py = xp.broadcast_arrays(x, y)
+        a, b = self._edges(xp)
+        ax, ay = a[:, 0], a[:, 1]
+        bx, by = b[:, 0], b[:, 1]
+        ex, ey = bx - ax, by - ay
+        ee = ex * ex + ey * ey
+        P = px[..., None] - ax
+        Q = py[..., None] - ay
+        t = xp.clip((P * ex + Q * ey) / ee, 0.0, 1.0)
+        dx = P - t * ex
+        dy = Q - t * ey
+        d = xp.sqrt((dx * dx + dy * dy).min(axis=-1))
+        return xp.where(self.contains(px, py, xp), -d, d)
+
+    def normalize(self) -> "Polygon":
+        verts = [( _canon_float(x), _canon_float(y))
+                 for x, y in self.vertices]
+        # Canonical orientation: counter-clockwise (positive signed area).
+        area2 = sum(x0 * y1 - x1 * y0
+                    for (x0, y0), (x1, y1)
+                    in zip(verts, verts[1:] + verts[:1]))
+        if area2 < 0:
+            verts = verts[::-1]
+        # Canonical start: rotate the lexicographically smallest vertex
+        # to the front, so the same ring hashes equal from any start.
+        k = min(range(len(verts)), key=lambda i: verts[i])
+        verts = verts[k:] + verts[:k]
+        return Polygon(tuple(verts))
+
+    def to_obj(self) -> dict:
+        return {"type": "polygon",
+                "vertices": [[x, y] for x, y in self.vertices]}
+
+
+def _norm_children(shapes, flatten_type) -> tuple:
+    """Normalize boolean children: recurse, flatten same-type nests,
+    dedupe, and sort by fingerprint — permuted unions hash equal."""
+    flat = []
+    for s in shapes:
+        n = s.normalize()
+        if isinstance(n, flatten_type):
+            flat.extend(n.shapes)
+        else:
+            flat.append(n)
+    seen, out = set(), []
+    for s in flat:
+        fp = s.fingerprint
+        if fp not in seen:
+            seen.add(fp)
+            out.append(s)
+    return tuple(sorted(out, key=lambda s: s.fingerprint))
+
+
+@dataclasses.dataclass(frozen=True)
+class Union(GeometrySpec):
+    shapes: Tuple[GeometrySpec, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "shapes", tuple(self.shapes))
+        if len(self.shapes) < 1:
+            raise ValueError("union needs at least one shape")
+
+    def contains(self, x, y, xp=None):
+        out = self.shapes[0].contains(x, y, xp)
+        for s in self.shapes[1:]:
+            out = out | s.contains(x, y, xp)
+        return out
+
+    def sdf(self, x, y, xp=None):
+        xp = xp or _np()
+        out = self.shapes[0].sdf(x, y, xp)
+        for s in self.shapes[1:]:
+            out = xp.minimum(out, s.sdf(x, y, xp))
+        return out
+
+    def normalize(self) -> GeometrySpec:
+        children = _norm_children(self.shapes, Union)
+        return children[0] if len(children) == 1 else Union(children)
+
+    def to_obj(self) -> dict:
+        return {"type": "union",
+                "shapes": [s.to_obj() for s in self.shapes]}
+
+
+@dataclasses.dataclass(frozen=True)
+class Intersection(GeometrySpec):
+    shapes: Tuple[GeometrySpec, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "shapes", tuple(self.shapes))
+        if len(self.shapes) < 1:
+            raise ValueError("intersection needs at least one shape")
+
+    def contains(self, x, y, xp=None):
+        out = self.shapes[0].contains(x, y, xp)
+        for s in self.shapes[1:]:
+            out = out & s.contains(x, y, xp)
+        return out
+
+    def sdf(self, x, y, xp=None):
+        xp = xp or _np()
+        out = self.shapes[0].sdf(x, y, xp)
+        for s in self.shapes[1:]:
+            out = xp.maximum(out, s.sdf(x, y, xp))
+        return out
+
+    def normalize(self) -> GeometrySpec:
+        children = _norm_children(self.shapes, Intersection)
+        return (children[0] if len(children) == 1
+                else Intersection(children))
+
+    def to_obj(self) -> dict:
+        return {"type": "intersection",
+                "shapes": [s.to_obj() for s in self.shapes]}
+
+
+@dataclasses.dataclass(frozen=True)
+class Difference(GeometrySpec):
+    """``shape`` minus (the closure of) ``hole``."""
+
+    shape: GeometrySpec
+    hole: GeometrySpec
+
+    def contains(self, x, y, xp=None):
+        return self.shape.contains(x, y, xp) & ~self.hole.contains(x, y, xp)
+
+    def sdf(self, x, y, xp=None):
+        xp = xp or _np()
+        return xp.maximum(self.shape.sdf(x, y, xp),
+                          -self.hole.sdf(x, y, xp))
+
+    def normalize(self) -> "Difference":
+        return Difference(self.shape.normalize(), self.hole.normalize())
+
+    def to_obj(self) -> dict:
+        return {"type": "difference", "shape": self.shape.to_obj(),
+                "hole": self.hole.to_obj()}
+
+
+@dataclasses.dataclass(frozen=True)
+class SDF(GeometrySpec):
+    """Raw level-set callable ``fn(x, y) -> array`` (negative inside,
+    continuous, zero on the boundary). ``name`` is mandatory and IS the
+    fingerprint identity — a callable has no stable content hash, so two
+    SDFs with the same name are treated as the same geometry (cache
+    sharing included). Not JSON-parseable: in-process requests only."""
+
+    fn: Callable = dataclasses.field(compare=False, hash=False)
+    name: str = ""
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError(
+                "SDF specs need a name=: the fingerprint (canvas-cache "
+                "and co-batching key) cannot hash a callable")
+
+    def contains(self, x, y, xp=None):
+        return self.fn(x, y) < 0.0
+
+    def sdf(self, x, y, xp=None):
+        return self.fn(x, y)
+
+    def to_obj(self) -> dict:
+        return {"type": "sdf", "name": self.name}
+
+
+_PARSERS = {}
+
+
+def _parse_ellipse(o):
+    return Ellipse(o.get("cx", 0.0), o.get("cy", 0.0),
+                   o.get("rx", 1.0), o.get("ry", 0.5))
+
+
+def _parse_rect(o):
+    return Rectangle(o["x0"], o["y0"], o["x1"], o["y1"])
+
+
+def _parse_polygon(o):
+    verts = []
+    for v in o["vertices"]:
+        if not isinstance(v, (list, tuple)) or len(v) != 2:
+            raise ValueError(
+                f"polygon vertices must be [x, y] pairs, got {v!r}")
+        verts.append((v[0], v[1]))
+    return Polygon(tuple(verts))
+
+
+def _parse_union(o):
+    return Union(tuple(_parse_obj(s) for s in o["shapes"]))
+
+
+def _parse_intersection(o):
+    return Intersection(tuple(_parse_obj(s) for s in o["shapes"]))
+
+
+def _parse_difference(o):
+    return Difference(_parse_obj(o["shape"]), _parse_obj(o["hole"]))
+
+
+def _parse_sdf(o):
+    raise ValueError(
+        "SDF specs carry a Python callable and cannot be parsed from "
+        "JSON; construct geometry.SDF(fn, name=...) in-process instead")
+
+
+_PARSERS.update({
+    "ellipse": _parse_ellipse, "rect": _parse_rect,
+    "rectangle": _parse_rect, "polygon": _parse_polygon,
+    "union": _parse_union, "intersection": _parse_intersection,
+    "difference": _parse_difference, "sdf": _parse_sdf,
+})
+
+# Per-type key whitelists: a misspelled parameter ("Rx", "radius") must
+# NOT fall through to a default and silently solve the wrong domain.
+_FIELDS = {
+    "ellipse": {"type", "cx", "cy", "rx", "ry"},
+    "rect": {"type", "x0", "y0", "x1", "y1"},
+    "rectangle": {"type", "x0", "y0", "x1", "y1"},
+    "polygon": {"type", "vertices"},
+    "union": {"type", "shapes"},
+    "intersection": {"type", "shapes"},
+    "difference": {"type", "shape", "hole"},
+    "sdf": {"type", "name"},
+}
+
+
+def _parse_obj(o) -> GeometrySpec:
+    if not isinstance(o, dict) or "type" not in o:
+        raise ValueError(f"geometry spec must be an object with a "
+                         f"'type' key, got {o!r}")
+    t = str(o["type"]).lower()
+    if t not in _PARSERS:
+        raise ValueError(
+            f"unknown geometry type {t!r}; known: "
+            f"{', '.join(sorted(k for k in _PARSERS if k != 'rectangle'))}")
+    unknown = set(o) - _FIELDS[t]
+    if unknown:
+        raise ValueError(
+            f"geometry type {t!r} got unknown field(s) "
+            f"{', '.join(sorted(map(repr, unknown)))}; allowed: "
+            f"{', '.join(sorted(_FIELDS[t] - {'type'}))}")
+    try:
+        return _PARSERS[t](o)
+    except KeyError as e:
+        raise ValueError(f"geometry type {t!r} is missing field {e}")
+
+
+def parse_geometry(spec) -> GeometrySpec:
+    """Coerce ``spec`` (GeometrySpec | dict | JSON string) into a
+    normalized :class:`GeometrySpec`."""
+    if isinstance(spec, GeometrySpec):
+        return spec.normalize()
+    if isinstance(spec, str):
+        try:
+            spec = json.loads(spec)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"geometry spec is not valid JSON: {e}")
+    return _parse_obj(spec).normalize()
+
+
+def fingerprint_of(spec: Optional[GeometrySpec]) -> str:
+    """The taint/attribution key the serve layer uses: a spec's
+    fingerprint, or the sentinel ``"default"`` for requests with no
+    geometry (the reference ellipse path)."""
+    return spec.fingerprint if spec is not None else "default"

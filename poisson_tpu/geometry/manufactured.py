@@ -1,0 +1,219 @@
+"""Manufactured-solution accuracy gate, per geometry family.
+
+The ellipse path has an analytic oracle — u = (1 − x² − 4y²)/10 solves
+−Δu = 1 on the reference domain — and BENCH.md gates every backend on
+its L2-vs-analytic landing at the discretisation floor. A new geometry
+family ships under the SAME rule: each family below pairs a spec with an
+exact solution u (vanishing on ∂D) and the forcing f = −Δu, the
+fictitious-domain solve runs against f·1_D, and the weighted L2 error
+over nodes strictly inside D must land at the floor the penalty method
+allows (O(√ε·‖u‖) boundary-layer error, ε = max(h1,h2)² — first order
+in h).
+
+Coverage is one case per DSL node type, each reduced to a domain with a
+closed-form solution:
+
+- ``ellipse`` — the reference domain itself (the existing oracle);
+- ``ellipse-offset`` — a translated, rescaled ellipse (quadratic u);
+- ``rectangle`` — closed-form canvas path, sine-product u;
+- ``polygon`` — the SAME rectangle entered as a 4-vertex polygon: the
+  adaptive sampler must reproduce the closed-form family's accuracy;
+- ``union`` / ``intersection`` / ``difference`` — boolean composites
+  whose result is (a disjoint pair of / exactly one) rectangle(s), so
+  the sine-product u still applies while the canvases exercise the
+  composite SDF sampling;
+- ``sdf`` — a raw-callable circle, quadratic u.
+
+``manufactured_error`` runs one case end to end and reports absolute +
+relative weighted L2; tests gate ``rel`` against per-family floors
+measured on CPU with 2× headroom (tests/test_geometry_dsl.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Optional
+
+import numpy as np
+
+from poisson_tpu.config import Problem
+from poisson_tpu.geometry.dsl import (
+    DEFAULT_ELLIPSE,
+    Difference,
+    Ellipse,
+    GeometrySpec,
+    Intersection,
+    Polygon,
+    Rectangle,
+    SDF,
+    Union,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ManufacturedCase:
+    """A geometry family's accuracy oracle: exact u inside D (zero is
+    assumed outside), and the forcing f = −Δu (None → the constant
+    ``problem.f_val``, i.e. the standard indicator RHS)."""
+
+    name: str
+    spec: GeometrySpec
+    u: Callable                      # (x, y) -> exact solution
+    f: Optional[Callable] = None     # (x, y) -> forcing; None = f_val
+
+
+def _quad_ellipse(e: Ellipse):
+    """u = c·(1 − tx² − ty²) with −Δu = 2c(1/rx² + 1/ry²) ≡ 1."""
+    c = 1.0 / (2.0 * (1.0 / e.rx ** 2 + 1.0 / e.ry ** 2))
+
+    def u(x, y):
+        tx = (x - e.cx) / e.rx
+        ty = (y - e.cy) / e.ry
+        return c * (1.0 - tx * tx - ty * ty)
+
+    return u
+
+
+def _sine_rect(r: Rectangle, c: float = 0.1):
+    """u = c·sin(π(x−x0)/Lx)·sin(π(y−y0)/Ly) on the box, with
+    f = −Δu = c·π²(1/Lx² + 1/Ly²)·sin·sin."""
+    lx, ly = r.x1 - r.x0, r.y1 - r.y0
+    k = c * math.pi ** 2 * (1.0 / lx ** 2 + 1.0 / ly ** 2)
+
+    def shape_fn(scale):
+        def fn(x, y):
+            sx = np.sin(np.pi * (x - r.x0) / lx)
+            sy = np.sin(np.pi * (y - r.y0) / ly)
+            val = scale * sx * sy
+            inside = (x > r.x0) & (x < r.x1) & (y > r.y0) & (y < r.y1)
+            return np.where(inside, val, 0.0)
+        return fn
+
+    return shape_fn(c), shape_fn(k)
+
+
+def _sum_fns(*fns):
+    def fn(x, y):
+        out = fns[0](x, y)
+        for g in fns[1:]:
+            out = out + g(x, y)
+        return out
+    return fn
+
+
+def cases() -> list:
+    """One manufactured case per shipped geometry family."""
+    out = []
+
+    # ellipse: the reference oracle itself, through the geometry path.
+    out.append(ManufacturedCase(
+        "ellipse", DEFAULT_ELLIPSE, _quad_ellipse(DEFAULT_ELLIPSE)))
+
+    off = Ellipse(cx=0.15, cy=-0.05, rx=0.6, ry=0.35)
+    out.append(ManufacturedCase("ellipse-offset", off, _quad_ellipse(off)))
+
+    rect = Rectangle(-0.7, -0.4, 0.5, 0.3)
+    u, f = _sine_rect(rect)
+    out.append(ManufacturedCase("rectangle", rect, u, f))
+
+    # The same box as a polygon ring: the sampler vs the closed form.
+    poly = Polygon(((-0.7, -0.4), (0.5, -0.4), (0.5, 0.3), (-0.7, 0.3)))
+    out.append(ManufacturedCase("polygon", poly, u, f))
+
+    r1 = Rectangle(-0.85, -0.35, -0.15, 0.25)
+    r2 = Rectangle(0.1, -0.3, 0.8, 0.3)
+    u1, f1 = _sine_rect(r1)
+    u2, f2 = _sine_rect(r2)
+    out.append(ManufacturedCase(
+        "union", Union((r1, r2)), _sum_fns(u1, u2), _sum_fns(f1, f2)))
+
+    # Overlapping boxes whose intersection is exactly a rectangle.
+    ia = Rectangle(-0.8, -0.45, 0.3, 0.35)
+    ib = Rectangle(-0.4, -0.3, 0.7, 0.5)
+    ir = Rectangle(-0.4, -0.3, 0.3, 0.35)
+    ui, fi = _sine_rect(ir)
+    out.append(ManufacturedCase(
+        "intersection", Intersection((ia, ib)), ui, fi))
+
+    # A bite that spans the big box's full y-extent, so what remains is
+    # exactly a rectangle again.
+    big = Rectangle(-0.8, -0.4, 0.6, 0.3)
+    bite = Rectangle(0.0, -0.5, 0.9, 0.4)
+    rem = Rectangle(-0.8, -0.4, 0.0, 0.3)
+    ud, fd = _sine_rect(rem)
+    out.append(ManufacturedCase(
+        "difference", Difference(big, bite), ud, fd))
+
+    r = 0.45
+    circle = SDF(lambda x, y: x * x + y * y - r * r, name=f"circle-{r}")
+
+    def u_circ(x, y):
+        return 0.25 * (r * r - x * x - y * y)     # −Δu = 1
+
+    out.append(ManufacturedCase("sdf", circle, u_circ))
+    return out
+
+
+def case_by_name(name: str) -> ManufacturedCase:
+    for c in cases():
+        if c.name == name:
+            return c
+    raise KeyError(name)
+
+
+def manufactured_error(case: ManufacturedCase, M: int, N: int,
+                       dtype=None) -> dict:
+    """Run ``case`` end to end on an M×N grid and measure the weighted
+    L2 error over nodes strictly inside D (the BENCH.md oracle rule,
+    applied to the family's own exact solution).
+
+    Returns ``{"l2", "rel", "iterations", "flag"}`` — ``rel`` is the
+    error relative to ‖u‖, the number the per-family floor gates."""
+    import jax.numpy as jnp
+
+    from poisson_tpu.geometry.canvas import build_geometry_fields
+    from poisson_tpu.ops.stencil import diag_D
+    from poisson_tpu.solvers.pcg import (
+        _solve,
+        resolve_dtype,
+        resolve_scaled,
+    )
+
+    problem = Problem(M=M, N=N)
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(None, dtype_name)
+    a64, b64, rhs64 = build_geometry_fields(problem, case.spec,
+                                            rhs_fn=case.f)
+    d64 = diag_D(a64, b64, problem.h1, problem.h2)
+    if use_scaled:
+        inv = 1.0 / np.sqrt(d64)
+        rhs_use = np.pad(rhs64[1:-1, 1:-1] * inv, 1)
+        aux64 = np.pad(inv, 1)
+    else:
+        rhs_use = rhs64
+        aux64 = np.pad(d64, 1)
+    dt = jnp.dtype(dtype_name)
+    result = _solve(problem, use_scaled, 0, jnp.asarray(a64, dt),
+                    jnp.asarray(b64, dt), jnp.asarray(rhs_use, dt),
+                    jnp.asarray(aux64, dt))
+
+    w = np.asarray(result.w, np.float64)
+    i_idx = np.arange(problem.M + 1)
+    j_idx = np.arange(problem.N + 1)
+    x = (problem.x_min + i_idx.astype(np.float64) * problem.h1)[:, None]
+    y = (problem.y_min + j_idx.astype(np.float64) * problem.h2)[None, :]
+    mask = case.spec.contains(x, y, np)
+    u = np.where(mask, case.u(x, y), 0.0)
+    werr = np.where(mask, (w - u) ** 2, 0.0)
+    wnorm = np.where(mask, u ** 2, 0.0)
+    scale = problem.h1 * problem.h2
+    l2 = float(np.sqrt(werr.sum() * scale))
+    norm = float(np.sqrt(wnorm.sum() * scale))
+    return {
+        "case": case.name,
+        "l2": l2,
+        "rel": l2 / norm if norm else float("inf"),
+        "iterations": int(np.asarray(result.iterations)),
+        "flag": int(np.asarray(result.flag)),
+    }

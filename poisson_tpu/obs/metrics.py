@@ -30,6 +30,22 @@ Naming convention (dotted, low cardinality):
   multi-RHS driver traffic (``solvers.batched``): members solved, padding
   overhead, and whether ragged batch sizes are reusing bucket
   executables;
+- ``geom.cache.hits`` / ``geom.cache.misses`` — the geometry canvas
+  cache (``poisson_tpu.geometry.canvas.geometry_setup``), keyed by
+  (fingerprint, grid box, f_val, dtype, scaled) the way the jit cache
+  keys shapes: a **miss** pays one host-side fp64 canvas bake
+  (closed-form segment lengths or adaptive SDF face sampling) + cast +
+  transfer; a **hit** reuses the device arrays across requests,
+  buckets, and lane splices. Read next to
+  ``batched.bucket_cache.{hits,misses}`` to tell the two reuse stories
+  apart: a NEW geometry family on a warm grid is a ``geom.cache.miss``
+  + ``batched.bucket_cache.hit`` pair (new canvases, zero recompiles —
+  the mixed-geometry co-batching claim, measured);
+- ``serve.requeued.geometry_isolated`` — requeues that applied
+  geometry-FINGERPRINT taint on top of the request-id mutual taint
+  (``serve.service``): a batch kill in a mixed-geometry bucket marks
+  the co-failed *families*, so a bad geometry can never re-co-batch
+  with its batchmates under a fresh request id;
 - ``bench.backend_probe.failures`` — bench.py backend probes that
   failed before a platform decision (a tunnel outage fingerprint, not a
   slowdown — regress.py and the forensics report read it as such);

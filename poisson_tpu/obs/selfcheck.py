@@ -25,7 +25,11 @@ causal tree, decomposition summing to wall, timeline render) with the
 and the durable solve fleet: a kill-one-worker drill (quarantine →
 recovery → restart) whose write-ahead journal replays back to the same
 ledger, with the ``serve_fleet_*``/``serve_journal_*`` counters
-surviving exposition.
+surviving exposition. Step 15 (last of all, clean registry) proves
+geometry-as-a-request: two geometry families built → a rebuild is a
+fingerprint-cache hit → both families co-batch in ONE bucket executable
+(geom miss + bucket hit on the second family — zero recompiles) → the
+``geom_*`` counters survive exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -420,6 +424,51 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in fleet_parsed:
             return _fail(f"exposition lost the {prom_name} counter")
 
+    # 15. Geometry as a request (runs LAST, clean registry): build two
+    # geometry families → rebuilding is a fingerprint-cache hit → the
+    # two families co-batch in ONE bucket executable (the second family
+    # is a geom miss + bucket-cache hit: new canvases, zero recompiles)
+    # → the exposition carries the geom_* counters.
+    from poisson_tpu.geometry import Ellipse, Rectangle, geometry_setup
+    from poisson_tpu.geometry.canvas import reset_geometry_cache
+    from poisson_tpu.solvers.batched import (
+        reset_bucket_cache,
+        solve_batched,
+    )
+
+    obs_metrics.reset()
+    reset_bucket_cache()
+    reset_geometry_cache()
+    fam_a = Ellipse(cx=0.1, cy=0.0, rx=0.7, ry=0.4)
+    fam_b = Rectangle(-0.6, -0.3, 0.5, 0.3)
+    # float32/scaled: x64-independent (the selfcheck runs either way).
+    geometry_setup(problem, fam_a, "float32", True)
+    geometry_setup(problem, fam_a, "float32", True)    # rebuild → hit
+    if obs_metrics.get("geom.cache.hits") != 1 \
+            or obs_metrics.get("geom.cache.misses") != 1:
+        return _fail(
+            f"fingerprint cache arithmetic off: hits="
+            f"{obs_metrics.get('geom.cache.hits')}, misses="
+            f"{obs_metrics.get('geom.cache.misses')}")
+    geo_res = solve_batched(problem, rhs_gates=[1.0, 1.1],
+                            geometries=[fam_a, fam_b])
+    import numpy as _np
+
+    if not bool(_np.all(_np.asarray(geo_res.flag) == 1)):
+        return _fail(f"mixed co-batch solve did not converge: "
+                     f"flags {_np.asarray(geo_res.flag)}")
+    solve_batched(problem, rhs_gates=[1.0, 1.2],
+                  geometries=[fam_b, fam_b])
+    if obs_metrics.get("batched.bucket_cache.hits") != 1:
+        return _fail("second geometry mix did not reuse the bucket "
+                     "executable")
+    geom_parsed = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_geom_cache_hits",
+                      "poisson_tpu_geom_cache_misses"):
+        if prom_name not in geom_parsed:
+            return _fail(f"exposition lost the {prom_name} counter")
+    geom_hits = obs_metrics.get("geom.cache.hits")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -430,8 +479,9 @@ def run_selfcheck(out_dir: str) -> int:
           f"refill-poison-splice green), flight recorder ok "
           f"(trace {tid} complete, {len(bucket_keys)} histogram "
           f"buckets), solve fleet ok ({int(quarantines)} quarantine, "
-          f"{int(recovered)} recovered, journal replay agrees) "
-          f"({out_dir})")
+          f"{int(recovered)} recovered, journal replay agrees), "
+          f"geometry ok ({int(geom_hits)} canvas-cache hits, mixed "
+          f"co-batch on one executable) ({out_dir})")
     return 0
 
 

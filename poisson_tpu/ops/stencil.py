@@ -16,12 +16,18 @@ write the interior.
 
 Every op is polymorphic in leading batch dimensions: state arrays may carry
 any number of leading axes (the batched multi-RHS driver,
-``solvers.batched``, stacks B right-hand sides as (B, M+1, N+1)), while the
-coefficient fields a/b/d stay unbatched and broadcast — the operator is
-shared across the batch, which is the whole point of batching (one traced
-program, one coefficient load, B solves). Reductions (``dot_weighted`` and
-friends) reduce ONLY the trailing grid axes, so they are per-member sums, and
-on an unbatched 2D grid they lower to the identical full reduce as before.
+``solvers.batched``, stacks B right-hand sides as (B, M+1, N+1)). The
+coefficient fields a/b/d may stay unbatched and broadcast — the operator
+shared across the batch (one traced program, one coefficient load, B
+solves) — or carry their OWN leading batch axis, giving each member its
+own geometry canvases (``poisson_tpu.geometry``: mixed-geometry
+co-batching — the stencil is coefficient-driven, so different domains on
+the same grid are just different operands to the same program). All
+coefficient indexing is ellipsis-prefixed, which on unbatched 2D fields
+resolves to the identical slices as before — the unbatched HLO is
+byte-for-byte unchanged. Reductions (``dot_weighted`` and friends) reduce
+ONLY the trailing grid axes, so they are per-member sums, and on an
+unbatched 2D grid they lower to the identical full reduce as before.
 
 These pure-JAX ops are the framework's *reference implementation* — the role
 stage4's retained CPU fallbacks played (SURVEY §7.5); fused Pallas TPU kernels
@@ -38,6 +44,21 @@ def interior(u):
     return u[..., 1:-1, 1:-1]
 
 
+def _cslice(field, rows, cols):
+    """Coefficient-field slice, batch-polymorphic on the LAST two axes.
+
+    2D fields take the literal ``field[rows, cols]`` — the exact
+    historical indexing, so the unbatched programs stay instruction-for-
+    instruction what they always were (jnp lowers an Ellipsis index
+    through its gather path before XLA simplifies it back; dispatching
+    on ndim keeps even the traced jaxpr identical). Batched fields
+    (leading member axes — per-member geometry canvases) get the same
+    slice under an Ellipsis."""
+    if field.ndim == 2:
+        return field[rows, cols]
+    return field[..., rows, cols]
+
+
 def pad_interior(u_int):
     """Embed a (…, M-1, N-1) interior block into the zero Dirichlet ring
     (leading batch axes, if any, are left untouched)."""
@@ -51,26 +72,33 @@ def apply_A(w, a, b, h1: float, h2: float):
     (Aw)ij = −[a_{i+1,j}(w_{i+1,j}−w_ij) − a_ij(w_ij−w_{i−1,j})]/h1²
              −[b_{i,j+1}(w_{i,j+1}−w_ij) − b_ij(w_ij−w_{i,j−1})]/h2²
     (``stage0/Withoutopenmp1.cpp:75-88``). ``w`` may carry leading batch
-    axes; a/b stay (M+1, N+1) and broadcast.
+    axes; a/b either stay (M+1, N+1) and broadcast (shared operator) or
+    carry matching leading axes (per-member geometry canvases).
     """
     wc = w[..., 1:-1, 1:-1]
+    mid = slice(1, -1)
     ax = (
-        a[2:, 1:-1] * (w[..., 2:, 1:-1] - wc)
-        - a[1:-1, 1:-1] * (wc - w[..., :-2, 1:-1])
+        _cslice(a, slice(2, None), mid) * (w[..., 2:, 1:-1] - wc)
+        - _cslice(a, mid, mid) * (wc - w[..., :-2, 1:-1])
     ) / (h1 * h1)
     ay = (
-        b[1:-1, 2:] * (w[..., 1:-1, 2:] - wc)
-        - b[1:-1, 1:-1] * (wc - w[..., 1:-1, :-2])
+        _cslice(b, mid, slice(2, None)) * (w[..., 1:-1, 2:] - wc)
+        - _cslice(b, mid, mid) * (wc - w[..., 1:-1, :-2])
     ) / (h2 * h2)
     return pad_interior(-(ax + ay))
 
 
 def diag_D(a, b, h1: float, h2: float):
     """Jacobi diagonal D_ij = (a_{i+1,j}+a_ij)/h1² + (b_{i,j+1}+b_ij)/h2²
-    over the interior, shape (M-1, N-1) (``stage0/Withoutopenmp1.cpp:91-103``).
+    over the interior, shape (…, M-1, N-1)
+    (``stage0/Withoutopenmp1.cpp:91-103``). Leading batch axes on a/b
+    (per-member canvases) produce per-member diagonals.
     """
-    return (a[2:, 1:-1] + a[1:-1, 1:-1]) / (h1 * h1) + (
-        b[1:-1, 2:] + b[1:-1, 1:-1]
+    mid = slice(1, -1)
+    return (
+        _cslice(a, slice(2, None), mid) + _cslice(a, mid, mid)
+    ) / (h1 * h1) + (
+        _cslice(b, mid, slice(2, None)) + _cslice(b, mid, mid)
     ) / (h2 * h2)
 
 
@@ -78,7 +106,8 @@ def apply_Dinv(r, d):
     """z = D⁻¹ r with a precomputed interior diagonal ``d`` (z=0 where D==0,
     ``stage0/Withoutopenmp1.cpp:100``; D > 0 always holds here since a,b ≥ 1,
     the guard is kept for parity). ``r`` may carry leading batch axes; ``d``
-    stays (M-1, N-1) and broadcasts.
+    either stays (M-1, N-1) and broadcasts or carries matching leading
+    axes (per-member geometry diagonals).
 
     The reference recomputes D from a, b on every call
     (``stage0/Withoutopenmp1.cpp:91-103``, ``stage4:…cu:541-562`` — its

@@ -110,12 +110,19 @@ class SolveJournal:
         obs.inc("serve.journal.records")
 
     def submit(self, request: SolveRequest, trace_id: str) -> None:
+        req = {k: getattr(request, k) for k in _REQUEST_FIELDS}
+        if request.geometry is not None:
+            # The spec's canonical JSON reconstructs the geometry on
+            # replay (raw-SDF specs serialize name-only and replay as
+            # unreconstructable — audibly torn, never silently solved
+            # as the wrong domain).
+            req["geometry"] = request.geometry.to_json()
         self.record(
             "submit", request_id=str(request.request_id),
             trace_id=trace_id,
             problem={k: getattr(request.problem, k)
                      for k in _PROBLEM_FIELDS},
-            request={k: getattr(request, k) for k in _REQUEST_FIELDS},
+            request=req,
             has_hook=request.on_chunk is not None,
         )
 
@@ -137,6 +144,9 @@ class PendingRequest:
     attempts: int = 0
     in_flight: bool = False      # mid-dispatch / lane-resident at crash
     taint: Set[str] = dataclasses.field(default_factory=set)
+    # Geometry-fingerprint taint (requeue-recorded): never-co-batch
+    # families survive the crash like the request-id pairs do.
+    taint_fp: Set[str] = dataclasses.field(default_factory=set)
     generation: int = 1          # 1 + prior recover records for this id
     lost_hook: bool = False      # an on_chunk hook did not survive
 
@@ -197,6 +207,7 @@ def replay_journal(path: str) -> JournalReplay:
     open_dispatch: Dict[str, Set[str]] = {}   # request_id -> co-ids
     open_lanes: Dict[object, Set[str]] = {}   # worker -> resident ids
     taints: Dict[str, Set[str]] = {}          # requeue-recorded taint
+    fp_taints: Dict[str, Set[str]] = {}       # geometry-fingerprint taint
     generations: Dict[str, int] = {}
 
     def _close(rid_: str) -> None:
@@ -237,9 +248,13 @@ def replay_journal(path: str) -> JournalReplay:
                 _close(i)
             if kind == "requeue":
                 # Mutual-taint pairs established before the crash must
-                # survive the replay (never-co-batch-again is forever).
+                # survive the replay (never-co-batch-again is forever) —
+                # the geometry-fingerprint pairs included.
                 taints[rid] = (taints.get(rid, set())
                                | {str(t) for t in rec.get("taint", ())})
+                fp_taints[rid] = (fp_taints.get(rid, set())
+                                  | {str(t) for t in
+                                     rec.get("taint_fp", ())})
         elif kind == "recover":
             generations[rid] = generations.get(rid, 0) + 1
             _close(rid)
@@ -266,6 +281,14 @@ def replay_journal(path: str) -> JournalReplay:
         try:
             problem = Problem(**rec["problem"])
             req_fields = dict(rec.get("request") or {})
+            geo_json = req_fields.pop("geometry", None)
+            if geo_json:
+                from poisson_tpu.geometry.dsl import parse_geometry
+
+                # Raw-SDF specs raise here (a callable does not survive
+                # JSON) and fall into the unreconstructable branch —
+                # audible, never the wrong domain.
+                req_fields["geometry"] = parse_geometry(geo_json)
             request = SolveRequest(request_id=rid, problem=problem,
                                    **req_fields)
         except (KeyError, TypeError, ValueError) as e:
@@ -282,6 +305,7 @@ def replay_journal(path: str) -> JournalReplay:
             in_flight=rid in open_dispatch,
             taint=(set(open_dispatch.get(rid, ()))
                    | taints.get(rid, set())),
+            taint_fp=fp_taints.get(rid, set()),
             generation=generations.get(rid, 0) + 1,
             lost_hook=bool(rec.get("has_hook")),
         ))

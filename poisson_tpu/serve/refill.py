@@ -48,12 +48,15 @@ class LaneTable:
     ``entries[lane]`` is the occupant (None = EMPTY)."""
 
     def __init__(self, cohort: str, problem, dtype, bucket: int,
-                 chunk: int, worker_id: int = 0):
+                 chunk: int, worker_id: int = 0,
+                 multi_geometry: bool = False):
         self.cohort = cohort
         self.problem = problem
         self.worker_id = worker_id
+        self.multi_geometry = bool(multi_geometry)
         self.batch = LaneBatch(
             problem, bucket, dtype=dtype, chunk=chunk,
+            multi_geometry=multi_geometry,
             # Chunk-boundary hook (solvers.lanes): each boundary is a
             # timeline event, so a wedged lane program's last boundary
             # is on disk for forensics — attributed to the worker that
@@ -94,18 +97,48 @@ class LaneTable:
                 taints |= e.taint
         return taints
 
+    def occupant_fps(self) -> Set:
+        from poisson_tpu.geometry.dsl import fingerprint_of
+
+        return {fingerprint_of(e.request.geometry)
+                for e in self.entries
+                if e is not None and e.request.geometry is not None}
+
+    def occupant_fp_taints(self) -> Set:
+        taints: Set = set()
+        for e in self.entries:
+            if e is not None:
+                taints |= e.taint_fp
+        return taints
+
     def taint_compatible(self, entry) -> bool:
         """True iff ``entry`` may share lanes with the current occupants:
         none of them is on its never-co-batch list and it is on none of
         theirs — the taint-pair exclusion that must hold *across a
-        splice*, not just at batch formation."""
+        splice*, not just at batch formation. Keys on (request,
+        fingerprint): the request-id pairs AND the geometry-fingerprint
+        pairs are both checked, so a bad geometry cannot rejoin its
+        batchmates under a fresh request id either."""
+        from poisson_tpu.geometry.dsl import fingerprint_of
+
         ids = self.occupant_ids()
-        return not (entry.taint & ids) and (
-            entry.request.request_id not in self.occupant_taints())
+        if (entry.taint & ids) or (
+                entry.request.request_id in self.occupant_taints()):
+            return False
+        if entry.request.geometry is not None and \
+                fingerprint_of(entry.request.geometry) in \
+                self.occupant_fp_taints():
+            return False
+        return not (entry.taint_fp & self.occupant_fps())
 
     def splice(self, entry, rhs_gate: float = 1.0) -> int:
-        """EMPTY → ACTIVE for ``entry``; returns the lane."""
-        lane = self.batch.splice(entry.request.request_id, rhs_gate)
+        """EMPTY → ACTIVE for ``entry``; returns the lane. On a
+        multi-geometry table the entry's canvases splice in with its
+        state (``solvers.lanes``) — same executable, new domain."""
+        lane = self.batch.splice(
+            entry.request.request_id, rhs_gate,
+            geometry=(entry.request.geometry if self.multi_geometry
+                      else None))
         self.entries[lane] = entry
         self._k_mark[lane] = 0      # a spliced member starts at k = 0
         obs.inc("serve.refill.splices")

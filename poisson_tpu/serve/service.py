@@ -79,6 +79,7 @@ from poisson_tpu.obs.flight import (
     FlightRecorder,
     SLOTracker,
 )
+from poisson_tpu.geometry.dsl import fingerprint_of
 from poisson_tpu.serve.breaker import CircuitBreaker
 from poisson_tpu.serve.deadline import Deadline
 from poisson_tpu.serve.fleet import (
@@ -111,8 +112,8 @@ class _Entry:
     """Queue-resident lifecycle state for one admitted request."""
 
     __slots__ = ("request", "admitted_at", "deadline", "attempts",
-                 "taint", "not_before", "escalate", "last_failure",
-                 "iter_cap", "recovered")
+                 "taint", "taint_fp", "not_before", "escalate",
+                 "last_failure", "iter_cap", "recovered")
 
     def __init__(self, request: SolveRequest, admitted_at: float,
                  deadline: Optional[Deadline]):
@@ -121,11 +122,25 @@ class _Entry:
         self.deadline = deadline
         self.attempts = 0          # dispatches so far
         self.taint: set = set()    # request_ids never to co-batch with again
+        # Geometry FINGERPRINTS never to co-batch with again: taint keys
+        # on (request, fingerprint), so a geometry family implicated in
+        # a batch kill is excluded wholesale — a fresh request carrying
+        # the same bad fingerprint cannot re-kill this entry either.
+        self.taint_fp: set = set()
         self.not_before = 0.0      # backoff gate (service clock)
         self.escalate = False      # next dispatch via the resilient driver
         self.last_failure = ""
         self.iter_cap = None       # degraded per-member cap (lane splices)
         self.recovered = False     # pulled off a dead worker / the journal
+
+
+def _geo_fps(entries) -> set:
+    """The geometry fingerprints present in a batch of entries —
+    the (request, fingerprint) taint unit. Requests with no geometry
+    contribute nothing: the 'default' path is not a suspect family
+    (request-id taint already isolates those pairs)."""
+    return {fingerprint_of(e.request.geometry) for e in entries
+            if e.request.geometry is not None}
 
 
 def _percentile(sorted_vals: Sequence[float], q: float) -> float:
@@ -431,6 +446,7 @@ class SolveService:
         points — then the ordinary retry budget decides retry vs typed
         error."""
         co_ids = {e.request.request_id for e in entries}
+        co_fps = _geo_fps(entries)
         for entry in entries:
             rid = entry.request.request_id
             entry.recovered = True
@@ -441,7 +457,7 @@ class SolveService:
                                reason=reason)
             self._retry_or_fail(entry, ERROR_TRANSIENT,
                                 f"worker {worker.id} {reason} "
-                                "mid-dispatch", co_ids - {rid})
+                                "mid-dispatch", co_ids - {rid}, co_fps)
 
     def _handle_worker_fault(self, worker: Worker, exc: Exception,
                              entries: List[_Entry], did: str,
@@ -569,7 +585,13 @@ class SolveService:
 
     def _cohort(self, request: SolveRequest) -> str:
         p = request.problem
-        return f"{p.M}x{p.N}:{request.dtype or 'auto'}:xla"
+        base = f"{p.M}x{p.N}:{request.dtype or 'auto'}:xla"
+        # Geometry requests form their own cohorts — the executable
+        # family differs (stacked canvases) — but the FINGERPRINT stays
+        # out of the key: different geometries on the same grid share
+        # the cohort, the bucket executable, and the breaker, which is
+        # the mixed-geometry co-batching seam.
+        return base + (":geo" if request.geometry is not None else "")
 
     def _breaker(self, worker: Worker, cohort: str) -> CircuitBreaker:
         """The ``worker``'s breaker for ``cohort``: breaker state is
@@ -595,19 +617,29 @@ class SolveService:
         batch = [head]
         ids = {head.request.request_id}
         taints = set(head.taint)
+        # Fingerprint-keyed exclusion, both directions: the batch's
+        # accumulated geometry fingerprints vs the candidate's taint
+        # list, and the candidate's fingerprint vs the batch's.
+        fps = {fingerprint_of(head.request.geometry)}
+        taint_fps = set(head.taint_fp)
         kept = deque()
         while self._queue and len(batch) < self.policy.max_batch:
             e = self._queue.popleft()
+            e_fp = fingerprint_of(e.request.geometry)
             compatible = (
                 not self._solo(e)
                 and self._cohort(e.request) == cohort
                 and e.request.request_id not in taints
                 and not (ids & e.taint)
+                and e_fp not in taint_fps
+                and not (fps & e.taint_fp)
             )
             if compatible:
                 batch.append(e)
                 ids.add(e.request.request_id)
                 taints |= e.taint
+                fps.add(e_fp)
+                taint_fps |= e.taint_fp
             else:
                 kept.append(e)
         kept.extend(self._queue)
@@ -655,7 +687,11 @@ class SolveService:
 
     def _lane_cohort(self, entry: _Entry, level: int) -> str:
         p = entry.request.problem
-        return f"{p.M}x{p.N}:{self._effective_dtype(entry, level)}:xla"
+        base = f"{p.M}x{p.N}:{self._effective_dtype(entry, level)}:xla"
+        # Same rule as _cohort: the :geo marker splits executables, the
+        # fingerprint never does — mixed geometries share the lane table.
+        return base + (":geo" if entry.request.geometry is not None
+                       else "")
 
     def _step_continuous(self, worker: Worker) -> bool:
         """One cycle of the refill engine: promote backed-off work,
@@ -772,6 +808,7 @@ class SolveService:
                 None if eff_dtype == "auto" else eff_dtype,
                 bucket, self.policy.refill_chunk,
                 worker_id=worker.id,
+                multi_geometry=head.request.geometry is not None,
             )
             self._note_sticky(worker, head_cohort, head.request.problem,
                               None if eff_dtype == "auto" else eff_dtype,
@@ -826,9 +863,11 @@ class SolveService:
                 self._journal.record("splice", request_id=str(rid),
                                      worker=worker.id, lane=lane)
             self._flight.end(rid, SPAN_QUEUE)
-            self._flight.begin(rid, SPAN_RESIDENT, mode="lane",
-                               bucket=table.bucket, lane=lane,
-                               level=level, worker=worker.id)
+            attrs = dict(mode="lane", bucket=table.bucket, lane=lane,
+                         level=level, worker=worker.id)
+            if entry.request.geometry is not None:
+                attrs["geometry"] = fingerprint_of(entry.request.geometry)
+            self._flight.begin(rid, SPAN_RESIDENT, **attrs)
         while kept:        # skipped entries return in arrival order
             self._queue.appendleft(kept.pop())
 
@@ -874,9 +913,11 @@ class SolveService:
             evicted = table.evict_all()
             worker.table = None
             co_ids = {en.request.request_id for en in evicted}
+            co_fps = _geo_fps(evicted)
             for en in evicted:
                 self._retry_or_fail(en, ERROR_TRANSIENT, str(e),
-                                    co_ids - {en.request.request_id})
+                                    co_ids - {en.request.request_id},
+                                    co_fps)
             return
         except Exception as e:  # internal: surfaced, never retried
             breaker.record_failure()
@@ -907,6 +948,7 @@ class SolveService:
         from poisson_tpu.solvers.pcg import FLAG_DEADLINE, FLAG_NONE
 
         co_ids = table.occupant_ids()
+        co_fps = _geo_fps(table.occupants())
         any_failed = False
         any_clean = False
         for view in views:
@@ -943,6 +985,7 @@ class SolveService:
                 entry, flag, result.iterations, result.diff,
                 restarts=0, cap=cap,
                 co_ids=co_ids - {entry.request.request_id},
+                co_fps=co_fps,
             )
             any_failed = any_failed or failed
             any_clean = any_clean or not failed
@@ -1005,9 +1048,13 @@ class SolveService:
         for entry in batch:
             rid = entry.request.request_id
             self._flight.end(rid, SPAN_QUEUE)
-            self._flight.begin(rid, SPAN_RESIDENT, dispatch=did,
-                               mode=mode, batch=len(batch), level=level,
-                               worker=worker.id)
+            attrs = dict(dispatch=did, mode=mode, batch=len(batch),
+                         level=level, worker=worker.id)
+            if entry.request.geometry is not None:
+                # Fingerprint attribution: a mixed-geometry dispatch's
+                # members are distinguishable in the causal trace.
+                attrs["geometry"] = fingerprint_of(entry.request.geometry)
+            self._flight.begin(rid, SPAN_RESIDENT, **attrs)
         if self._journal is not None:
             self._journal.record(
                 "dispatch", worker=worker.id, mode=mode,
@@ -1043,9 +1090,11 @@ class SolveService:
             self._flight_dispatch_failed(batch, did, t_disp,
                                          type(e).__name__)
             co_ids = {entry.request.request_id for entry in batch}
+            co_fps = _geo_fps(batch)
             for entry in batch:
                 self._retry_or_fail(entry, ERROR_TRANSIENT, str(e),
-                                    co_ids - {entry.request.request_id})
+                                    co_ids - {entry.request.request_id},
+                                    co_fps)
             return
         except Exception as e:  # internal: surfaced, never retried
             breaker.record_failure()
@@ -1076,14 +1125,20 @@ class SolveService:
                           t_disp: float) -> bool:
         from poisson_tpu.solvers.batched import solve_batched
 
+        # Geometry cohorts dispatch with per-member canvases — mixed
+        # fingerprints share the one stacked-canvas bucket executable.
+        geoms = [e.request.geometry for e in batch]
         result = solve_batched(
             problem,
             rhs_gates=[e.request.rhs_gate for e in batch],
             member_ids=[e.request.request_id for e in batch],
             dtype=dtype,
             bucket=(len(batch) if exact_bucket else None),
+            geometries=(geoms if any(g is not None for g in geoms)
+                        else None),
         )
         co_ids = {e.request.request_id for e in batch}
+        co_fps = _geo_fps(batch)
         iters = np.asarray(result.iterations)
         flags = np.asarray(result.flag)
         diffs = np.asarray(result.diff)
@@ -1107,6 +1162,7 @@ class SolveService:
                 entry, int(flags[i]), int(iters[i]), float(diffs[i]),
                 restarts=0, cap=problem.iteration_cap,
                 co_ids=co_ids - {entry.request.request_id},
+                co_fps=co_fps,
             )
             any_failed = any_failed or failed
         return any_failed
@@ -1121,10 +1177,15 @@ class SolveService:
 
         req = entry.request
         chunk = req.chunk or self.policy.default_chunk
-        # The RHS gate folds into f_val so both solo drivers see it the
-        # same way (the batched path uses rhs_gates for the shared-setup
-        # win; a solo dispatch has nothing to share).
-        solo_problem = problem.with_(f_val=problem.f_val * req.rhs_gate)
+        # The RHS gate rides rhs_gate (not f_val) when a geometry is
+        # present — the canvas cache keys on f_val, and a gate folded
+        # into it would fragment the cache per gate. Without geometry,
+        # folding into f_val keeps the historical solo path unchanged.
+        if req.geometry is not None:
+            solo_problem = problem
+        else:
+            solo_problem = problem.with_(
+                f_val=problem.f_val * req.rhs_gate)
         rid = req.request_id
         if entry.escalate and self.policy.retry.escalate_divergence:
             obs.inc("serve.escalations")
@@ -1144,6 +1205,9 @@ class SolveService:
             result = pcg_solve_chunked(
                 solo_problem, chunk=chunk, dtype=dtype,
                 deadline=entry.deadline, on_chunk=req.on_chunk,
+                geometry=req.geometry,
+                rhs_gate=(req.rhs_gate if req.geometry is not None
+                          else None),
             )
         # Flight: a solo dispatch's whole wall is this member's compute
         # (it shares the program with nobody).
@@ -1163,7 +1227,7 @@ class SolveService:
 
     def _classify_member(self, entry: _Entry, flag: int, iterations: int,
                          diff: float, restarts: int, cap: int,
-                         co_ids: set) -> bool:
+                         co_ids: set, co_fps: set = frozenset()) -> bool:
         """Turn one member's stop verdict into an outcome or a retry.
         Returns True iff this member counts as a dispatch failure for the
         breaker."""
@@ -1194,11 +1258,11 @@ class SolveService:
         # breakdown / nonfinite / stagnated: divergence-class failure.
         self._retry_or_fail(entry, ERROR_DIVERGENCE,
                             f"solver stopped: {name} at iteration "
-                            f"{iterations}", co_ids)
+                            f"{iterations}", co_ids, co_fps)
         return True
 
     def _retry_or_fail(self, entry: _Entry, error_type: str, message: str,
-                       co_ids: set) -> None:
+                       co_ids: set, co_fps: set = frozenset()) -> None:
         entry.attempts += 1
         entry.last_failure = error_type
         max_attempts = (entry.request.max_attempts
@@ -1219,10 +1283,26 @@ class SolveService:
                 return
         # Mutual taint: this member never shares a bucket with its failed
         # batchmates again (and vice versa, applied on their entries) —
-        # a poisoned member cannot re-kill the same cohort twice.
+        # a poisoned member cannot re-kill the same cohort twice. The
+        # fingerprint half keys on the GEOMETRY: any request carrying a
+        # co-failed member's geometry family is excluded too, so a bad
+        # geometry never re-co-batches with its batchmates under a fresh
+        # request id.
         entry.taint |= co_ids
+        if co_fps:
+            new_fps = (set(co_fps)
+                       - {fingerprint_of(entry.request.geometry)}
+                       - entry.taint_fp)
+            if new_fps:
+                entry.taint_fp |= new_fps
+                obs.inc("serve.requeued.geometry_isolated")
+        # Divergence escalation runs the single-request resilient driver,
+        # which solves the reference geometry — a geometry request must
+        # not escalate into solving the wrong domain; it retries through
+        # the ordinary (geometry-aware) dispatch instead.
         entry.escalate = (error_type == ERROR_DIVERGENCE
-                          and self.policy.retry.escalate_divergence)
+                          and self.policy.retry.escalate_divergence
+                          and entry.request.geometry is None)
         entry.not_before = self._clock() + delay
         obs.inc("serve.retries")
         obs.inc("serve.backoff_seconds", delay)
@@ -1236,7 +1316,8 @@ class SolveService:
                 "requeue", request_id=str(entry.request.request_id),
                 attempt=entry.attempts, error=error_type,
                 recovered=entry.recovered,
-                taint=sorted(str(t) for t in entry.taint))
+                taint=sorted(str(t) for t in entry.taint),
+                taint_fp=sorted(entry.taint_fp))
         obs.event("serve.retry", request_id=str(entry.request.request_id),
                   attempt=entry.attempts, delay=round(delay, 4),
                   error=error_type, escalate=entry.escalate)
@@ -1397,6 +1478,7 @@ class SolveService:
             entry.recovered = True
             entry.attempts = pend.attempts
             entry.taint = set(pend.taint)
+            entry.taint_fp = set(getattr(pend, "taint_fp", ()) or ())
             self._counts["recovered"] += 1
             obs.inc("serve.recovered")
             self._pending_ids.add(req.request_id)

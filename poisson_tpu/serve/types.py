@@ -65,6 +65,17 @@ class SolveRequest:
     be enforceable); the rest ride the batched multi-RHS path.
     ``on_chunk`` is the fault-injection seam (``testing.faults``) for
     chunked dispatches — None in production.
+
+    ``geometry`` makes the DOMAIN a request parameter
+    (:mod:`poisson_tpu.geometry`): a spec compiled to fingerprint-cached
+    coefficient canvases. Geometry requests form their own ``…:geo``
+    cohorts in which *different* geometries on the same grid co-batch
+    inside one bucket executable (only the canvases differ per member);
+    the fingerprint rides the flight trace for attribution, and
+    poison-isolation taint keys on (request, fingerprint) — a geometry
+    family implicated in a batch kill never re-co-batches with the
+    batchmates it took down. ``None`` is the reference ellipse path,
+    byte-identical to every prior release.
     """
 
     request_id: Union[int, str]
@@ -75,6 +86,7 @@ class SolveRequest:
     chunk: Optional[int] = None
     max_attempts: Optional[int] = None
     on_chunk: Optional[Callable] = None
+    geometry: Optional[object] = None     # geometry.dsl.GeometrySpec
 
 
 @dataclasses.dataclass(frozen=True)

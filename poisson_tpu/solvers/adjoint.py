@@ -15,21 +15,35 @@ solver into a building block for PDE-constrained optimisation: source
 identification, RHS calibration, end-to-end learning against solution
 functionals.
 
-Only the right-hand side is differentiated; the geometry coefficients are
-baked per ``Problem`` (differentiating the domain shape would require the
-ε-blend's derivative, which the fictitious-domain method does not define
-smoothly at face transitions).
+:func:`differentiable_solve` differentiates the right-hand side against
+the baked reference geometry. :func:`differentiable_geometry_solve` goes
+further: the coefficient canvases themselves are built IN-GRAPH from a
+closed-form :mod:`poisson_tpu.geometry` spec whose parameters may be
+tracers, so ``jax.grad`` flows through the ε-blend into the shape
+parameters — ∂w/∂(cx, cy, rx, ry) via the same implicit adjoint (the
+JVP of ``custom_linear_solve`` is dw = A⁻¹(db − dA·w), and dA is the
+canvas builder's parameter derivative). Every geometry request thereby
+becomes a differentiable design scenario: shape optimisation against
+any solution functional, at O(1) memory in the iteration count. The
+blend is piecewise-smooth — within a blend class a cut face's ℓ varies
+smoothly with the shape; the measure-zero class-transition boundaries
+carry subgradients, the standard situation for embedded-boundary shape
+differentiation (Glowinski, Pan & Périaux 1994, PAPERS.md). Sampled
+families (polygons, composites, raw SDFs) are built by host-side
+bisection and are deliberately rejected rather than returning silent
+zero gradients.
 """
 
 from __future__ import annotations
 
 import functools
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from poisson_tpu.config import Problem
-from poisson_tpu.ops.stencil import apply_A, interior, pad_interior
+from poisson_tpu.ops.stencil import apply_A, diag_D, interior, pad_interior
 from poisson_tpu.solvers.pcg import (
     _solve,
     host_setup,
@@ -77,3 +91,68 @@ def differentiable_solve(problem: Problem, rhs_grid, dtype=None,
     use_scaled = resolve_scaled(scaled, dtype_name)
     solve = _make_differentiable(problem, dtype_name, use_scaled)
     return solve(jnp.asarray(rhs_grid, jnp.dtype(dtype_name)))
+
+
+def differentiable_geometry_solve(problem: Problem, spec, dtype=None,
+                                  scaled=None):
+    """``w(spec)`` on the full (M+1, M+1) grid, differentiable in the
+    SHAPE parameters of a closed-form geometry spec.
+
+    ``spec`` is an :class:`~poisson_tpu.geometry.dsl.Ellipse` or
+    :class:`~poisson_tpu.geometry.dsl.Rectangle` whose numeric fields
+    may be jax tracers (build it inside the function being
+    differentiated). The canvases (a, b, B) come from
+    ``geometry.canvas.traced_fields`` — pure jnp, so their parameter
+    Jacobian exists — and the solve itself is wrapped in
+    ``lax.custom_linear_solve(symmetric=True)``: gradients are implicit
+    (one extra solve per cotangent), never an unroll of the CG loop.
+
+    The RHS indicator contributes no derivative (it is piecewise
+    constant in the parameters); the shape sensitivity flows through
+    the blend coefficients, which is exactly the fictitious-domain
+    shape derivative.
+    """
+    from poisson_tpu.geometry.canvas import traced_fields
+
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    dt = jnp.dtype(dtype_name)
+    h1, h2 = problem.h1, problem.h2
+    a, b, rhs = traced_fields(problem, spec, dtype=dt)
+    d = diag_D(a, b, h1, h2)
+    if use_scaled:
+        aux = pad_interior(1.0 / jnp.sqrt(d))
+    else:
+        aux = pad_interior(d)
+
+    def matvec(x):
+        return apply_A(x, a, b, h1, h2)
+
+    def solve_fn(_matvec, r):
+        # Primal/transpose solves reuse the jitted PCG machinery on the
+        # same (traced) canvases; custom_linear_solve differentiates
+        # around it implicitly, so the solver is a black box here.
+        ru = r * aux if use_scaled else r
+        return _solve(problem, use_scaled, 0, a, b, ru, aux).w
+
+    rhs_proj = pad_interior(interior(rhs))
+    return lax.custom_linear_solve(matvec, rhs_proj, solve_fn,
+                                   symmetric=True)
+
+
+def shape_gradient(problem: Problem, spec_fn, params, loss_fn,
+                   dtype=None, scaled=None):
+    """(loss, ∂loss/∂params) for a shape-design objective.
+
+    ``spec_fn(params)`` builds the closed-form geometry from a pytree of
+    parameters (e.g. ``lambda p: Ellipse(rx=p["rx"], ry=p["ry"])``);
+    ``loss_fn(w)`` scores the solution grid. One forward solve + one
+    adjoint solve, whatever the iteration counts — each solve request is
+    a differentiable design scenario."""
+
+    def objective(p):
+        w = differentiable_geometry_solve(problem, spec_fn(p),
+                                          dtype=dtype, scaled=scaled)
+        return loss_fn(w)
+
+    return jax.value_and_grad(objective)(params)

@@ -54,6 +54,7 @@ from poisson_tpu.solvers.pcg import (
     resolve_scaled,
     scaled_single_device_ops,
     single_device_ops,
+    solve_setup,
 )
 
 # Bucket ladder for padding ragged batch sizes onto a small set of compiled
@@ -125,6 +126,102 @@ def pcg_loop_batched(ops: PCGOps, rhs_stack, *, delta: float, max_iter: int,
     return lax.while_loop(cond, masked_body, init)
 
 
+def member_field_ops(problem: Problem, scaled: bool):
+    """Per-member ops factory for stacked-canvas programs. ONE
+    construction shared by the fused batched solve and the lane stepping
+    engine — their bit-parity contract rests on it."""
+
+    def member_ops(a, b, aux):
+        return (
+            scaled_single_device_ops(problem, a, b, aux)
+            if scaled
+            else single_device_ops(problem, a, b, aux)
+        )
+
+    return member_ops
+
+
+def pcg_step_batched_fields(problem: Problem, scaled: bool, a_stack,
+                            b_stack, aux_stack, state: PCGState,
+                            stop_at, *, delta: float,
+                            weighted_norm: bool, h1: float,
+                            h2: float) -> PCGState:
+    """Masked vmapped stepping over PER-MEMBER coefficient canvases:
+    every member solves its OWN fictitious domain with the shared PCG
+    body until it reaches ``stop_at`` — a scalar cap for the fused
+    solve, a per-member stop line for the lane engine
+    (:mod:`poisson_tpu.solvers.lanes`). Stopped/frozen members keep
+    their state via per-member select, exactly like
+    :func:`pcg_loop_batched`."""
+    member_ops = member_field_ops(problem, scaled)
+
+    def member_body(s: PCGState, a, b, aux) -> PCGState:
+        body = make_pcg_body(
+            member_ops(a, b, aux), delta=delta,
+            weighted_norm=weighted_norm, h1=h1, h2=h2,
+        )
+        return body(s)
+
+    vbody = jax.vmap(member_body)
+
+    def masked_body(s: PCGState) -> PCGState:
+        stepped = vbody(s, a_stack, b_stack, aux_stack)
+        frozen = s.done | (s.k >= stop_at)
+
+        def keep(old, new):
+            pred = frozen.reshape(frozen.shape + (1,) * (new.ndim - 1))
+            return jnp.where(pred, old, new)
+
+        return jax.tree_util.tree_map(keep, s, stepped)
+
+    def cond(s: PCGState):
+        return jnp.any((~s.done) & (s.k < stop_at))
+
+    return lax.while_loop(cond, masked_body, state)
+
+
+def pcg_loop_batched_fields(problem: Problem, scaled: bool, a_stack,
+                            b_stack, aux_stack, rhs_stack, *,
+                            delta: float, max_iter: int,
+                            weighted_norm: bool, h1: float,
+                            h2: float) -> PCGState:
+    """:func:`pcg_loop_batched` with PER-MEMBER coefficient canvases:
+    a/b/aux carry a leading (B, …) axis and are vmapped alongside the
+    state, so every member solves its OWN fictitious domain inside the
+    one fused ``while_loop`` (mixed-geometry co-batching,
+    ``poisson_tpu.geometry``). Member *i*'s arithmetic is the exact
+    sequential solve of its canvases — per-member reductions make lane
+    trajectories independent — so iterates/flags/counts match
+    ``pcg_solve(problem, geometry=g_i)`` bit-for-bit (asserted in
+    tests)."""
+    member_ops = member_field_ops(problem, scaled)
+    init = jax.vmap(
+        lambda rhs, a, b, aux: init_state(member_ops(a, b, aux), rhs)
+    )(rhs_stack, a_stack, b_stack, aux_stack)
+    return pcg_step_batched_fields(
+        problem, scaled, a_stack, b_stack, aux_stack, init, max_iter,
+        delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _solve_batched_geo(problem: Problem, scaled: bool, a_stack, b_stack,
+                       rhs_stack, aux_stack) -> PCGResult:
+    """jitted mixed-geometry batched solve: one executable per
+    (bucket, grid, dtype, scaled) — the SAME executable no matter which
+    geometries occupy the members (canvases are operands, never part of
+    the jit key), which is what lets a second geometry family land as a
+    bucket-cache hit with zero recompiles."""
+    s = pcg_loop_batched_fields(
+        problem, scaled, a_stack, b_stack, aux_stack, rhs_stack,
+        delta=problem.delta, max_iter=problem.iteration_cap,
+        weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+    w = s.w * aux_stack if scaled else s.w   # per-member unscale
+    return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr,
+                     flag=s.flag, max_iterations=jnp.max(s.k))
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1))
 def _solve_batched(problem: Problem, scaled: bool, a, b, rhs_stack,
                    aux) -> PCGResult:
@@ -178,7 +275,8 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
                   dtype=None, scaled=None, mesh=None,
                   buckets: Sequence[int] = DEFAULT_BUCKETS,
                   bucket: Optional[int] = None,
-                  member_ids: Optional[Sequence] = None) -> PCGResult:
+                  member_ids: Optional[Sequence] = None,
+                  geometries: Optional[Sequence] = None) -> PCGResult:
     """Solve a batch of Poisson problems in one fused device program.
 
     Input forms (exactly one):
@@ -215,6 +313,19 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     needs — a member re-enqueued into a *different* bucket after a fault
     keeps its request identity — and is useful standalone (aggregate
     bucket stats are no longer the only per-dispatch record).
+
+    ``geometries`` (optional, one :mod:`poisson_tpu.geometry` spec or
+    None per member) gives each member its OWN fictitious domain:
+    coefficient canvases stack on a leading batch axis and the shared
+    body is vmapped over them too, so *different geometries on the same
+    grid co-batch in one bucket executable* — the executable is keyed by
+    shapes alone, never by which domains occupy it (a second geometry
+    family is a ``geom.cache.miss`` + ``batched.bucket_cache.hit``, zero
+    recompiles). A None entry is the problem's default (the reference
+    ellipse); member *i* reproduces
+    ``pcg_solve(problem, geometry=g_i, rhs_gate=…)`` bit-for-bit.
+    Padding members reuse member 0's canvases with a zero RHS (they
+    stop degenerately at iteration 1 as before).
     """
     if mesh is not None:
         raise ValueError(
@@ -254,39 +365,77 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     # it away: batches differing only in RHS magnitude share one compiled
     # executable per bucket.
     jit_problem = problem.with_(f_val=1.0)
-    if member_problems is not None:
-        from poisson_tpu.solvers.pcg import host_fields64
+    geo = setups = None
+    if geometries is not None:
+        from poisson_tpu.geometry.dsl import parse_geometry
 
-        # One shared setup (a/b/aux are f_val-independent) plus per-member
-        # RHS by exact fp64 scaling of the unit-f_val base — NOT B full
-        # host setups (which would also thrash host_setup's small LRU).
-        # Bit-exactness vs host_setup(p_i): the indicator is 0/1 and the
-        # scaling is a single fp64 product either way (f·1[D]·D^{-1/2}
-        # associates without extra roundings), then the same cast.
-        a, b, _, aux = host_setup(jit_problem, dtype_name, use_scaled)
-        base64 = host_fields64(jit_problem, use_scaled)[2]
-        dt = jnp.dtype(dtype_name)
-        rhs_stack = jnp.stack([jnp.asarray(base64 * p.f_val, dt)
-                               for p in member_problems])
-        batch = len(member_problems)
+        geo = [None if g is None else parse_geometry(g)
+               for g in geometries]
+
+    def _geo_setups(base_problem, n, per_member_problems=None):
+        """One (a, b, rhs, aux) per member — fingerprint-cached device
+        canvases (``geometry.canvas``); None entries are the problem's
+        default ellipse via the exact host_setup arrays."""
+        if len(geo) != n:
+            raise ValueError(
+                f"geometries must have one entry per member: got "
+                f"{len(geo)} specs for batch {n}")
+        probs = per_member_problems or [base_problem] * n
+        return [solve_setup(p, dtype_name, use_scaled, geometry=g)
+                for p, g in zip(probs, geo)]
+
+    if member_problems is not None:
+        if geo is not None:
+            # Per-member setup (each member's canvases AND f_val-scaled
+            # RHS come from its own spec/problem — bit-parity with
+            # pcg_solve(p_i, geometry=g_i)).
+            setups = _geo_setups(problem, len(member_problems),
+                                 member_problems)
+            rhs_stack = jnp.stack([s[2] for s in setups])
+            batch = len(member_problems)
+        else:
+            from poisson_tpu.solvers.pcg import host_fields64
+
+            # One shared setup (a/b/aux are f_val-independent) plus
+            # per-member RHS by exact fp64 scaling of the unit-f_val
+            # base — NOT B full host setups (which would also thrash
+            # host_setup's small LRU). Bit-exactness vs host_setup(p_i):
+            # the indicator is 0/1 and the scaling is a single fp64
+            # product either way (f·1[D]·D^{-1/2} associates without
+            # extra roundings), then the same cast.
+            a, b, _, aux = host_setup(jit_problem, dtype_name, use_scaled)
+            base64 = host_fields64(jit_problem, use_scaled)[2]
+            dt = jnp.dtype(dtype_name)
+            rhs_stack = jnp.stack([jnp.asarray(base64 * p.f_val, dt)
+                                   for p in member_problems])
+            batch = len(member_problems)
     elif rhs_gates is not None:
-        a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+        if geo is None:
+            a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+        gate_dt = jnp.dtype(dtype_name)
         if hasattr(rhs_gates, "ndim"):
             # An existing (B,) array — possibly data-dependent on a prior
             # result (the bench's chaining trick: gates of exactly 1.0
             # computed from the previous solve serialize back-to-back
             # batched solves without changing any bit).
-            gates = jnp.asarray(rhs_gates, rhs.dtype).reshape(-1)
+            gates = jnp.asarray(rhs_gates, gate_dt).reshape(-1)
         else:
-            gates = jnp.stack([jnp.asarray(g, rhs.dtype).reshape(())
+            gates = jnp.stack([jnp.asarray(g, gate_dt).reshape(())
                                for g in rhs_gates])
         batch = gates.shape[0]
         if batch < 1:
             raise ValueError("rhs_gates must have at least one member")
-        # Per-member rhs * gate — elementwise, exactly pcg_solve's
-        # rhs_gate multiply, so gated members stay bit-identical to the
-        # sequential gated solve.
-        rhs_stack = rhs[None] * gates[:, None, None]
+        if geo is not None:
+            # Per-member unit canvases × the member's gate — exactly
+            # pcg_solve(problem, geometry=g, rhs_gate=gate)'s multiply.
+            setups = _geo_setups(problem, batch)
+            rhs_stack = jnp.stack([s[2] for s in setups]
+                                  ) * gates[:, None, None]
+        else:
+            # Per-member rhs * gate — elementwise, exactly pcg_solve's
+            # rhs_gate multiply, so gated members stay bit-identical to
+            # the sequential gated solve.
+            rhs_stack = rhs[None] * gates[:, None, None]
     else:
         a, b, _, aux = host_setup(jit_problem, dtype_name, use_scaled)
         rhs_stack = jnp.asarray(rhs_stack, jnp.dtype(dtype_name))
@@ -296,7 +445,12 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
                 f"{problem.grid_shape[1]}), got {rhs_stack.shape}"
             )
         batch = rhs_stack.shape[0]
-        if use_scaled:
+        if geo is not None:
+            setups = _geo_setups(jit_problem, batch)
+            if use_scaled:
+                # Physical B_i → member-scaled b̃_i = D_i^{-1/2}·B_i.
+                rhs_stack = rhs_stack * jnp.stack([s[3] for s in setups])
+        elif use_scaled:
             # Physical B → scaled b̃ = D^{-1/2}·B; aux IS D^{-1/2} on the
             # full grid (zero ring), so one broadcast multiply.
             rhs_stack = rhs_stack * aux
@@ -322,10 +476,31 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     # Keyed exactly like the jit call below ((static problem, scaled) +
     # the shapes/dtype the stacked operands carry), so the hit/miss
     # counters report real executable reuse, not an approximation of it.
-    key = (size, jit_problem, dtype_name, use_scaled)
-    _count_bucket(key, batch, size)
+    # The geometry path adds one marker — stacked canvases are a
+    # different operand signature, hence a different executable family —
+    # but NEVER the fingerprints: every geometry mix of a bucket shares
+    # one executable, which is the whole point of co-batching.
+    if geo is not None:
+        def stack_pad(idx):
+            stack = jnp.stack([s[idx] for s in setups])
+            if size > batch:
+                # Padding members reuse member 0's canvases (any valid
+                # operator works: their RHS is zero, they stop at k=1).
+                stack = jnp.concatenate(
+                    [stack, jnp.broadcast_to(
+                        stack[:1], (size - batch,) + stack.shape[1:])])
+            return stack
 
-    result = _solve_batched(jit_problem, use_scaled, a, b, rhs_stack, aux)
+        key = (size, jit_problem, dtype_name, use_scaled, "geo")
+        _count_bucket(key, batch, size)
+        result = _solve_batched_geo(jit_problem, use_scaled,
+                                    stack_pad(0), stack_pad(1),
+                                    rhs_stack, stack_pad(3))
+    else:
+        key = (size, jit_problem, dtype_name, use_scaled)
+        _count_bucket(key, batch, size)
+        result = _solve_batched(jit_problem, use_scaled, a, b, rhs_stack,
+                                aux)
     if size == batch:
         return result._replace(origin=origin)
     # Slice padding members off every batched field; max_iterations is

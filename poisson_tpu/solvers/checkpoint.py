@@ -501,7 +501,7 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
                       scaled=None, rhs_gate=None,
                       stagnation_window: int = 0, stream_every: int = 0,
                       watchdog=None, on_chunk=None,
-                      deadline=None) -> PCGResult:
+                      deadline=None, geometry=None) -> PCGResult:
     """Chunked single-device solve WITHOUT persistence: the same
     chunk-boundary loop as :func:`pcg_solve_checkpointed` (watchdog beats,
     fault hooks, deadline awareness) minus the disk. This is the dispatch
@@ -512,14 +512,20 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
 
     Converging runs produce the exact ``pcg_solve`` iterate sequence
     (chunking never changes the iterates, only where the host observes
-    them). ``rhs_gate`` mirrors ``pcg_solve``'s RHS multiplier. A deadline
-    expiry returns the partial iterate with ``flag == FLAG_DEADLINE``.
+    them). ``rhs_gate`` mirrors ``pcg_solve``'s RHS multiplier; so does
+    ``geometry`` (a :mod:`poisson_tpu.geometry` spec swaps the canvases,
+    the chunked program is unchanged — the service's deadline-carrying
+    geometry requests dispatch through here). A deadline expiry returns
+    the partial iterate with ``flag == FLAG_DEADLINE``.
     """
+    from poisson_tpu.solvers.pcg import solve_setup
+
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
-    a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+    a, b, rhs, aux = solve_setup(problem, dtype_name, use_scaled,
+                                 geometry=geometry)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     ops = (

@@ -54,6 +54,7 @@ from poisson_tpu.solvers.pcg import (
     resolve_scaled,
     scaled_single_device_ops,
     single_device_ops,
+    solve_setup,
 )
 
 
@@ -82,6 +83,21 @@ def _set_lane(state: PCGState, lane, member: PCGState) -> PCGState:
     """Write ``member``'s per-lane state into slot ``lane``."""
     return jax.tree_util.tree_map(
         lambda full, one: full.at[lane].set(one), state, member)
+
+
+@jax.jit
+def _set_field_lane(stack, lane, field):
+    """Write one member's 2D canvas into slot ``lane`` of a stacked
+    coefficient field (the multi-geometry splice: new canvases enter a
+    RUNNING bucket program as operands — the executables never change)."""
+    return stack.at[lane].set(field)
+
+
+@jax.jit
+def _take_field_lane(stack, lane):
+    """Read slot ``lane``'s 2D canvas out of a stacked field (retire
+    needs the member's own aux to unscale its iterate)."""
+    return stack[lane]
 
 
 @jax.jit
@@ -136,6 +152,27 @@ def _step_lanes(problem: Problem, scaled: bool, chunk: int,
     return lax.while_loop(cond, masked_body, state)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _step_lanes_geo(problem: Problem, scaled: bool, chunk: int,
+                    a_stack, b_stack, aux_stack,
+                    state: PCGState) -> PCGState:
+    """:func:`_step_lanes` with PER-LANE coefficient canvases: a/b/aux
+    carry a leading (bucket, …) axis and are vmapped with the state, so
+    every lane solves its own fictitious domain
+    (``poisson_tpu.geometry``) inside the same stepping executable.
+    Canvases are operands — splicing a NEW geometry into a freed lane
+    reuses this exact compiled program, no recompile. The vmapped
+    masked body is :func:`batched.pcg_step_batched_fields` — the SAME
+    construction as the fused solve, run to the per-lane stop line."""
+    from poisson_tpu.solvers.batched import pcg_step_batched_fields
+
+    stop_at = jnp.minimum(state.k + chunk, problem.iteration_cap)
+    return pcg_step_batched_fields(
+        problem, scaled, a_stack, b_stack, aux_stack, state, stop_at,
+        delta=problem.delta, weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2)
+
+
 class LaneResult(NamedTuple):
     """One retired lane's attributable outcome (host-side values)."""
 
@@ -168,11 +205,19 @@ class LaneBatch:
     """
 
     def __init__(self, problem: Problem, bucket: int, *, dtype=None,
-                 scaled=None, chunk: int = 50, on_boundary=None):
+                 scaled=None, chunk: int = 50, on_boundary=None,
+                 multi_geometry: bool = False):
         if bucket < 1:
             raise ValueError(f"bucket must be >= 1, got {bucket}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # Multi-geometry lanes (poisson_tpu.geometry): the coefficient
+        # canvases become PER-LANE stacks spliced alongside the state,
+        # so different fictitious domains share the one stepping
+        # executable. Decided at construction — a homogeneous table
+        # keeps the historical unstacked programs byte-for-byte, and an
+        # occupied program's operand signature can never change.
+        self.multi_geometry = bool(multi_geometry)
         # Chunk-boundary event hook (the flight recorder's seam): called
         # host-side after each step() with the step accounting
         # ({"step", "active", "idle", "chunk"}). Purely host-side — the
@@ -207,6 +252,14 @@ class LaneBatch:
             done=jnp.ones((self.bucket,), bool))
         self._blank = jax.tree_util.tree_map(lambda leaf: leaf[0],
                                              self.state)
+        if self.multi_geometry:
+            # Per-lane canvas stacks, seeded with the default (ellipse)
+            # canvases; EMPTY lanes keep whatever canvases last occupied
+            # them (they are frozen width either way).
+            wide = (self.bucket,) + problem.grid_shape
+            self._a_stack = jnp.broadcast_to(a, wide) + 0
+            self._b_stack = jnp.broadcast_to(b, wide) + 0
+            self._aux_stack = jnp.broadcast_to(aux, wide) + 0
         self.origin: List[object] = [None] * self.bucket
         self.steps = 0                # chunk steps executed
         self.idle_lane_steps = 0      # Σ over steps of non-ACTIVE lanes
@@ -225,13 +278,19 @@ class LaneBatch:
     # -- the state machine ---------------------------------------------
 
     def splice(self, member_id, rhs_gate: float = 1.0,
-               lane: Optional[int] = None) -> int:
+               lane: Optional[int] = None, geometry=None) -> int:
         """EMPTY → ACTIVE: load ``member_id``'s solve into a free lane.
 
         The member's init state is the sequential solver's ``init_state``
         of ``rhs · rhs_gate`` — the same arrays ``solve_batched`` stacks,
         so per-member independence (module docstring) makes the spliced
         trajectory identical to an unrefilled solve. Returns the lane.
+
+        ``geometry`` (multi-geometry tables only) splices the member's
+        OWN fingerprint-cached canvases into the lane with its state —
+        a new fictitious domain enters the running bucket executable as
+        operands, never as a recompile. ``None`` is the problem's
+        default (ellipse) canvases either way.
         """
         if member_id is None:
             raise ValueError("member_id must not be None (None marks an "
@@ -239,6 +298,10 @@ class LaneBatch:
         if member_id in self.origin:
             raise ValueError(f"member {member_id!r} already occupies lane "
                              f"{self.origin.index(member_id)}")
+        if geometry is not None and not self.multi_geometry:
+            raise ValueError(
+                "this LaneBatch was built single-geometry; construct it "
+                "with multi_geometry=True to splice per-member domains")
         if lane is None:
             free = self.free_lanes()
             if not free:
@@ -247,11 +310,22 @@ class LaneBatch:
         elif self.origin[lane] is not None:
             raise ValueError(f"lane {lane} is ACTIVE (member "
                              f"{self.origin[lane]!r})")
-        rhs = self._rhs * jnp.asarray(rhs_gate, self._rhs.dtype)
+        if geometry is not None:
+            ga, gb, grhs, gaux = solve_setup(
+                self.problem, self.dtype_name, self.use_scaled,
+                geometry=geometry)
+        else:
+            ga, gb, grhs, gaux = self._a, self._b, self._rhs, self._aux
+        rhs = grhs * jnp.asarray(rhs_gate, grhs.dtype)
         member = _member_init(self._jit_problem, self.use_scaled,
-                              self._a, self._b, self._aux, rhs)
-        self.state = _set_lane(self.state, jnp.asarray(lane, jnp.int32),
-                               member)
+                              ga, gb, gaux, rhs)
+        lane_idx = jnp.asarray(lane, jnp.int32)
+        self.state = _set_lane(self.state, lane_idx, member)
+        if self.multi_geometry:
+            self._a_stack = _set_field_lane(self._a_stack, lane_idx, ga)
+            self._b_stack = _set_field_lane(self._b_stack, lane_idx, gb)
+            self._aux_stack = _set_field_lane(self._aux_stack, lane_idx,
+                                              gaux)
         self.origin[lane] = member_id
         return lane
 
@@ -266,9 +340,16 @@ class LaneBatch:
         active = len(self.active_lanes())
         idle = self.bucket - active
         if active:
-            self.state = _step_lanes(self._jit_problem, self.use_scaled,
-                                     self.chunk, self._a, self._b,
-                                     self._aux, self.state)
+            if self.multi_geometry:
+                self.state = _step_lanes_geo(
+                    self._jit_problem, self.use_scaled, self.chunk,
+                    self._a_stack, self._b_stack, self._aux_stack,
+                    self.state)
+            else:
+                self.state = _step_lanes(self._jit_problem,
+                                         self.use_scaled,
+                                         self.chunk, self._a, self._b,
+                                         self._aux, self.state)
             self.steps += 1
             self.idle_lane_steps += idle
             if self.on_boundary is not None:
@@ -303,7 +384,13 @@ class LaneBatch:
         member, self.state = _take_lane(self.state,
                                         jnp.asarray(lane, jnp.int32),
                                         self._blank)
-        w = member.w * self._aux if self.use_scaled else member.w
+        if self.use_scaled:
+            aux = (_take_field_lane(self._aux_stack,
+                                    jnp.asarray(lane, jnp.int32))
+                   if self.multi_geometry else self._aux)
+            w = member.w * aux
+        else:
+            w = member.w
         result = LaneResult(
             member_id=member_id, lane=lane, w=w,
             iterations=int(member.k),

@@ -297,9 +297,14 @@ def single_device_ops(problem: Problem, a, b, aux) -> PCGOps:
     Every op accepts leading batch axes (the ``ops.stencil`` convention):
     reductions sum only the trailing grid axes, so a (B, M+1, N+1) state
     stack gets per-member dots/norms — usable either directly or under
-    ``vmap`` (the batched driver, ``solvers.batched``)."""
+    ``vmap`` (the batched driver, ``solvers.batched``). a/b/aux may
+    themselves carry leading batch axes (per-member geometry canvases,
+    ``poisson_tpu.geometry``)."""
     h1, h2 = problem.h1, problem.h2
-    d = aux[1:-1, 1:-1]
+    # ndim dispatch like ops.stencil._cslice: 2D aux keeps the literal
+    # historical slice (unbatched jaxpr unchanged); stacked aux
+    # (per-member geometry diagonals) slices under an Ellipsis.
+    d = aux[1:-1, 1:-1] if aux.ndim == 2 else aux[..., 1:-1, 1:-1]
     return PCGOps(
         apply_A=lambda p: apply_A(p, a, b, h1, h2),
         apply_Dinv=lambda r: apply_Dinv(r, d),
@@ -381,6 +386,22 @@ def host_setup(problem: Problem, dtype_name: str, scaled: bool):
     )
 
 
+def solve_setup(problem: Problem, dtype_name: str, scaled: bool,
+                geometry=None):
+    """The one setup seam every solver entry point routes through:
+    ``geometry=None`` is :func:`host_setup` (the reference ellipse,
+    byte-identical arrays to every prior release); a geometry spec swaps
+    in the fingerprint-cached canvases of ``geometry.canvas`` — same
+    shapes, same dtype, same (a, b, rhs, aux) contract, so the jitted
+    solve programs are shared across domains (the canvases are operands,
+    never part of the jit key)."""
+    if geometry is None:
+        return host_setup(problem, dtype_name, scaled)
+    from poisson_tpu.geometry.canvas import geometry_setup
+
+    return geometry_setup(problem, geometry, dtype_name, scaled)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def _solve(problem: Problem, scaled: bool, stream_every: int,
            a, b, rhs, aux) -> PCGResult:
@@ -434,7 +455,8 @@ def resolve_scaled(scaled, dtype_name: str) -> bool:
 
 
 def pcg_solve(problem: Problem, dtype=None, scaled=None,
-              rhs_gate=None, stream_every: int = 0) -> PCGResult:
+              rhs_gate=None, stream_every: int = 0,
+              geometry=None) -> PCGResult:
     """Single-device solve (the stage0/stage1 workload, SURVEY §3.1).
 
     The iteration is jit-compiled end to end; setup runs on the host in fp64
@@ -447,10 +469,16 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
     (serialized, bit-identical result). ``stream_every`` > 0 streams
     (k, ‖Δw‖) to the telemetry sink every that many iterations
     (``obs.stream``; 0 = off, the program is byte-identical).
+    ``geometry`` swaps the reference ellipse for any
+    :mod:`poisson_tpu.geometry` spec (same grid, same compiled program —
+    only the coefficient canvases change; fingerprint-cached, see
+    ``geom.cache.*``). Omitted, the solve is byte-identical to every
+    prior release.
     """
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
-    a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
+    a, b, rhs, aux = solve_setup(problem, dtype_name, use_scaled,
+                                 geometry=geometry)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     return _solve(problem, use_scaled, int(stream_every), a, b, rhs, aux)

@@ -154,11 +154,13 @@ def _quiet_degradation():
 
 
 def _reset_registries() -> None:
+    from poisson_tpu.geometry.canvas import reset_geometry_cache
     from poisson_tpu.obs import metrics
     from poisson_tpu.solvers.batched import reset_bucket_cache
 
     metrics.reset()
     reset_bucket_cache()
+    reset_geometry_cache()
 
 
 def _finish(name: str, seed: int, checks: dict, detail: dict) -> dict:
@@ -1108,6 +1110,89 @@ def _dedup_idempotent_submit(seed: int) -> dict:
         "dedup_hits_counted": _counter("serve.dedup.hits") == 2,
         "admitted_exactly_once": _counter("serve.admitted") == 1,
     }, {"outcome_kind": out.kind})
+
+
+@scenario("geometry-mixed-cobatch")
+def _geometry_mixed_cobatch(seed: int) -> dict:
+    """A mixed-geometry bucket under a poison-member fault: taint and
+    requeue key on (request, fingerprint) — the poisoned request never
+    re-co-batches with its batchmates, AND a fresh request carrying the
+    poison's GEOMETRY FAMILY never joins them either. Dispatch
+    compositions are recorded at the fault seam; the invariant is
+    asserted from the emitted ``serve.*`` snapshot like every scenario."""
+    from poisson_tpu.geometry import Ellipse, Rectangle, fingerprint_of
+    from poisson_tpu.serve import (
+        OUTCOME_ERROR,
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import compose_faults, poison_batch_fault
+
+    geo_a = Ellipse(cx=0.1, cy=0.0, rx=0.7, ry=0.4)     # the bad family
+    geo_b = Rectangle(-0.6, -0.3, 0.5, 0.3)
+    dispatches: list = []
+
+    def record(requests, attempts):
+        dispatches.append({r.request_id for r in requests})
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16, max_batch=8,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+            degradation=_quiet_degradation(),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=compose_faults(record,
+                                      poison_batch_fault({"poison"})),
+    )
+    p = _problem()
+    svc.submit(SolveRequest(request_id="poison", problem=p,
+                            geometry=geo_a))
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=f"innocent-{i}", problem=p,
+                                geometry=geo_b, rhs_gate=1.0 + i / 10))
+    # Pump until the first batch kill has happened, then submit a FRESH
+    # request carrying the poison's geometry family: the fingerprint
+    # half of the taint must keep it away from the tainted innocents.
+    while svc.pump():
+        if _counter("serve.retries") >= 1:
+            break
+    svc.submit(SolveRequest(request_id="twin", problem=p,
+                            geometry=geo_a))
+    outs = {o.request_id: o for o in svc.drain()}
+    innocents = [outs[f"innocent-{i}"] for i in range(3)]
+    kill_at = next(i for i, ids in enumerate(dispatches)
+                   if "poison" in ids)
+    mates = dispatches[kill_at] - {"poison"}
+    # After the kill: the poison must never share a dispatch with its
+    # batchmates again (request taint), and NO carrier of the poison's
+    # fingerprint — the twin included — may join them (fingerprint
+    # taint). The twin may still co-batch with the poison (same family,
+    # no pair taint), which is exactly the (request, fingerprint) rule.
+    violations = [
+        ids for ids in dispatches[kill_at + 1:]
+        if (("poison" in ids or "twin" in ids) and (ids & mates))
+    ]
+    fps = {rid: fingerprint_of(g) for rid, g in
+           [("poison", geo_a), ("twin", geo_a),
+            ("innocent-0", geo_b)]}
+    return _finish("geometry-mixed-cobatch", seed, {
+        "mixed_bucket_cobatched": len(mates) == 3
+        and fps["poison"] != fps["innocent-0"],
+        "twin_shares_bad_fingerprint": fps["twin"] == fps["poison"],
+        "bad_geometry_never_rejoined_batchmates": not violations,
+        "poison_got_typed_error": outs["poison"].kind == OUTCOME_ERROR
+        and outs["poison"].error_type == "transient",
+        "innocents_converged": all(o.converged for o in innocents),
+        "twin_converged": outs["twin"].converged,
+        "geometry_isolation_counted":
+            _counter("serve.requeued.geometry_isolated") >= 1,
+    }, {"dispatches": [sorted(map(str, d)) for d in dispatches],
+        "poison_attempts": outs["poison"].attempts})
 
 
 # -- campaign runner ----------------------------------------------------

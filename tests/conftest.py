@@ -85,6 +85,15 @@ def pytest_configure(config):
         "ledger invariant; CPU-fast; runs in tier-1, selectable with "
         "-m fleet)",
     )
+    config.addinivalue_line(
+        "markers",
+        "geom: geometry-as-a-request suite (DSL normalization/"
+        "fingerprints, canvas compilation incl. ellipse bit-parity "
+        "with the reference setup, manufactured-solution accuracy "
+        "gates per family, mixed-geometry co-batching parity, shape "
+        "gradients; CPU-fast; runs in tier-1, selectable with "
+        "-m geom)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

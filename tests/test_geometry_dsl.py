@@ -1,0 +1,700 @@
+"""Geometry-as-a-request suite (``-m geom``).
+
+Pins the whole geometry subsystem (``poisson_tpu/geometry/`` and its
+threading through the solver/serve layers):
+
+- DSL normalization and fingerprint stability — permuted unions,
+  rotated/reversed polygon rings, and swapped rectangle corners hash
+  equal; JSON round-trips preserve fingerprints;
+- ellipse-spec canvas bit-parity with ``fictitious_domain.build_fields``
+  (the default spec IS the reference setup, to the last ULP) and
+  default-path solve parity (``geometry=None`` vs the explicit default
+  spec, bit-for-bit);
+- manufactured-solution L2 at the discretisation floor, one oracle per
+  shipped family — the same rule BENCH.md applies to the ellipse;
+- mixed-geometry batched/lane solves match per-geometry sequential
+  solves bit-for-bit, inside ONE bucket executable (cache counters
+  prove no recompile on the second family);
+- a seeded random-polygon sweep (the geometry-space analog of
+  ``test_random_geometry.py``'s grid/mesh sweep): sampled canvases vs
+  an independent fractional-membership estimate, and the solve
+  converging with a finite, bounded solution;
+- shape gradients vs finite differences (``solvers.adjoint``);
+- sentinel cohort pins: ``detail.geometry_mix`` is experiment identity.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.geometry import (
+    DEFAULT_ELLIPSE,
+    Difference,
+    Ellipse,
+    Intersection,
+    Polygon,
+    Rectangle,
+    SDF,
+    Union,
+    build_geometry_fields,
+    fingerprint_of,
+    parse_geometry,
+)
+from poisson_tpu.geometry.canvas import reset_geometry_cache
+from poisson_tpu.geometry.manufactured import (
+    case_by_name,
+    cases,
+    manufactured_error,
+)
+
+pytestmark = pytest.mark.geom
+
+
+# -- DSL normalization & fingerprints -----------------------------------
+
+
+def test_default_ellipse_is_reference_domain():
+    e = DEFAULT_ELLIPSE
+    assert (e.cx, e.cy, e.rx, e.ry) == (0.0, 0.0, 1.0, 0.5)
+    assert bool(e.contains(0.0, 0.0, np))
+    assert not bool(e.contains(1.0, 0.0, np))
+    assert not bool(e.contains(0.0, 0.5, np))
+
+
+def test_union_fingerprint_permutation_invariant():
+    a = Ellipse(cx=0.1, rx=0.5, ry=0.3)
+    b = Rectangle(-0.5, -0.3, 0.5, 0.3)
+    c = Ellipse(cx=-0.2, rx=0.4, ry=0.2)
+    u1 = Union((a, b, c))
+    u2 = Union((c, a, b))
+    u3 = Union((b, Union((c, a))))        # nested: flattened equal
+    assert u1.fingerprint == u2.fingerprint == u3.fingerprint
+    # … and a different member set hashes differently.
+    assert Union((a, b)).fingerprint != u1.fingerprint
+
+
+def test_polygon_fingerprint_rotation_and_orientation_invariant():
+    ring = ((0.0, 0.0), (0.6, 0.0), (0.6, 0.4), (0.0, 0.4))
+    p1 = Polygon(ring)
+    p2 = Polygon(ring[2:] + ring[:2])       # rotated start
+    p3 = Polygon(ring[::-1])                # reversed orientation
+    assert p1.fingerprint == p2.fingerprint == p3.fingerprint
+
+
+def test_rectangle_corner_order_normalizes():
+    r1 = Rectangle(-0.5, -0.3, 0.5, 0.3)
+    r2 = Rectangle(-0.5, -0.3, 0.5, 0.3).normalize()
+    assert r1.fingerprint == r2.fingerprint
+    # Parsed JSON round trip preserves the fingerprint.
+    assert parse_geometry(r1.to_json()).fingerprint == r1.fingerprint
+
+
+def test_json_round_trip_every_family():
+    specs = [
+        DEFAULT_ELLIPSE,
+        Rectangle(-0.5, -0.3, 0.5, 0.3),
+        Polygon(((0.0, 0.0), (0.6, 0.0), (0.3, 0.4))),
+        Union((Ellipse(rx=0.4, ry=0.2), Rectangle(-0.2, -0.2, 0.2, 0.2))),
+        Intersection((Ellipse(rx=0.6, ry=0.4),
+                      Rectangle(-0.5, -0.5, 0.5, 0.5))),
+        Difference(Ellipse(rx=0.7, ry=0.4),
+                   Rectangle(-0.2, -0.1, 0.2, 0.1)),
+    ]
+    for spec in specs:
+        back = parse_geometry(spec.to_json())
+        assert back.fingerprint == spec.fingerprint, spec
+
+
+def test_sdf_spec_needs_name_and_rejects_json_parse():
+    with pytest.raises(ValueError, match="name"):
+        SDF(lambda x, y: x + y)
+    s = SDF(lambda x, y: x * x + y * y - 0.16, name="circle-0.4")
+    assert s.fingerprint == SDF(lambda x, y: 0.0 * x,
+                                name="circle-0.4").fingerprint
+    with pytest.raises(ValueError, match="callable"):
+        parse_geometry(s.to_json())
+
+
+def test_parse_rejects_unknown_and_malformed():
+    with pytest.raises(ValueError, match="unknown geometry type"):
+        parse_geometry({"type": "torus"})
+    with pytest.raises(ValueError, match="missing field"):
+        parse_geometry({"type": "rect", "x0": 0})
+    with pytest.raises(ValueError, match="JSON"):
+        parse_geometry("{not json")
+    with pytest.raises(ValueError):
+        Ellipse(rx=-1.0)
+    with pytest.raises(ValueError):
+        Rectangle(0.5, 0.0, -0.5, 0.3)
+
+
+def test_fingerprint_of_sentinel():
+    assert fingerprint_of(None) == "default"
+    assert fingerprint_of(DEFAULT_ELLIPSE).startswith("g")
+
+
+# -- canvas compilation -------------------------------------------------
+
+
+def test_default_ellipse_canvases_bit_identical_to_reference():
+    from poisson_tpu.models.fictitious_domain import build_fields
+
+    for M, N in ((40, 40), (17, 23)):
+        p = Problem(M=M, N=N)
+        a0, b0, r0 = build_fields(p, dtype=np.float64, xp=np)
+        a1, b1, r1 = build_geometry_fields(p, DEFAULT_ELLIPSE)
+        assert np.array_equal(np.asarray(a0), a1), (M, N)
+        assert np.array_equal(np.asarray(b0), b1), (M, N)
+        assert np.array_equal(np.asarray(r0), r1), (M, N)
+
+
+def test_default_spec_solve_bit_identical_to_no_geometry():
+    from poisson_tpu.solvers.pcg import pcg_solve
+
+    p = Problem(M=40, N=40)
+    plain = pcg_solve(p)
+    spec = pcg_solve(p, geometry=DEFAULT_ELLIPSE)
+    assert int(plain.iterations) == int(spec.iterations) == 50  # golden
+    assert np.array_equal(np.asarray(plain.w), np.asarray(spec.w))
+
+
+def test_sampled_polygon_matches_closed_form_rectangle():
+    p = Problem(M=40, N=40)
+    rect = Rectangle(-0.7, -0.4, 0.5, 0.3)
+    poly = Polygon(((-0.7, -0.4), (0.5, -0.4), (0.5, 0.3), (-0.7, 0.3)))
+    ar, br, _ = build_geometry_fields(p, rect)
+    ap, bp, _ = build_geometry_fields(p, poly)
+    # 1/eps amplifies face-length error; the bisection pins crossings to
+    # ~h·2^-44, so the blended coefficients agree to ~1e-10.
+    np.testing.assert_allclose(ar, ap, atol=1e-9)
+    np.testing.assert_allclose(br, bp, atol=1e-9)
+
+
+def test_coefficient_bounds_every_family():
+    p = Problem(M=32, N=32)
+    for case in cases():
+        a, b, _ = build_geometry_fields(p, case.spec)
+        for arr in (a, b):
+            assert arr.min() >= 1.0 - 1e-12, case.name
+            assert arr.max() <= 1.0 / p.eps + 1e-9, case.name
+
+
+def test_canvas_cache_fingerprint_keyed():
+    from poisson_tpu.geometry import geometry_setup
+    from poisson_tpu.obs import metrics
+
+    metrics.reset()
+    reset_geometry_cache()
+    p = Problem(M=24, N=24)
+    spec = Ellipse(cx=0.1, rx=0.6, ry=0.35)
+    twin = parse_geometry(spec.to_json())     # equal spec, new object
+    geometry_setup(p, spec, "float64", False)
+    geometry_setup(p, twin, "float64", False)
+    # delta/max_iter are solver knobs, not canvas identity.
+    geometry_setup(p.with_(delta=1e-9), spec, "float64", False)
+    assert metrics.get("geom.cache.misses") == 1
+    assert metrics.get("geom.cache.hits") == 2
+    geometry_setup(p, Ellipse(cx=0.2, rx=0.6, ry=0.35), "float64", False)
+    assert metrics.get("geom.cache.misses") == 2
+
+
+def test_unbatched_stencil_hlo_unchanged():
+    """The batch-axis generalisation must cost the classic path nothing:
+    on 2D coefficient fields, apply_A compiles to the byte-identical
+    HLO of a literal 2D-only implementation (debug metadata aside)."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    from poisson_tpu.ops.stencil import apply_A, pad_interior
+
+    def apply_A_2d(w, a, b, h1, h2):
+        # The pre-geometry implementation, verbatim.
+        wc = w[..., 1:-1, 1:-1]
+        ax = (
+            a[2:, 1:-1] * (w[..., 2:, 1:-1] - wc)
+            - a[1:-1, 1:-1] * (wc - w[..., :-2, 1:-1])
+        ) / (h1 * h1)
+        ay = (
+            b[1:-1, 2:] * (w[..., 1:-1, 2:] - wc)
+            - b[1:-1, 1:-1] * (wc - w[..., 1:-1, :-2])
+        ) / (h2 * h2)
+        return pad_interior(-(ax + ay))
+
+    def hlo(fn):
+        w = jnp.ones((41, 41))
+        a = jnp.ones((41, 41))
+        b = jnp.ones((41, 41))
+        txt = jax.jit(lambda w, a, b: fn(w, a, b, 0.05, 0.03)).lower(
+            w, a, b).compile().as_text()
+        return re.sub(r", metadata=\{[^}]*\}", "", txt)
+
+    assert hlo(apply_A) == hlo(apply_A_2d)
+
+
+# -- manufactured-solution accuracy gates -------------------------------
+
+# Relative L2 floors at the pinned 64×64 grid, measured on CPU fp64 with
+# ~2x headroom (the penalty method's boundary layer is O(h); measured
+# values 2026-08: ellipse 3.0e-2, ellipse-offset 4.9e-2, rectangle/
+# polygon 2.6e-2, union 3.3e-2, intersection 4.7e-2, difference 2.5e-2,
+# sdf 7.1e-2). A family drifting past its floor is a real accuracy
+# regression, not noise: the solves are deterministic.
+_FLOOR_REL = {
+    "ellipse": 6e-2,
+    "ellipse-offset": 1e-1,
+    "rectangle": 6e-2,
+    "polygon": 6e-2,
+    "union": 7e-2,
+    "intersection": 1e-1,
+    "difference": 5e-2,
+    "sdf": 1.5e-1,
+}
+
+
+@pytest.mark.parametrize("name", sorted(_FLOOR_REL))
+def test_manufactured_solution_at_floor(name):
+    case = case_by_name(name)
+    r = manufactured_error(case, 64, 64)
+    assert r["flag"] == 1, r                      # converged
+    assert r["rel"] <= _FLOOR_REL[name], r
+
+
+def test_manufactured_error_shrinks_under_refinement():
+    # First-order boundary-layer convergence, checked on the
+    # SMOOTH-boundary families (closed-form ellipses and the sampled
+    # circle SDF): doubling the resolution must shrink the error. The
+    # axis-aligned families are deliberately excluded — their error
+    # oscillates with how the box edges align to grid faces
+    # (superconvergent when an edge lands on a face), so monotone
+    # refinement is not a sound assertion for them; their absolute
+    # floors above are the gate.
+    for name in ("ellipse", "ellipse-offset", "sdf"):
+        coarse = manufactured_error(case_by_name(name), 48, 48)
+        fine = manufactured_error(case_by_name(name), 96, 96)
+        assert fine["rel"] < 0.8 * coarse["rel"], (name, coarse, fine)
+
+
+# -- mixed-geometry co-batching -----------------------------------------
+
+
+def test_mixed_batched_matches_sequential_bitwise():
+    from poisson_tpu.solvers.batched import solve_batched
+    from poisson_tpu.solvers.pcg import pcg_solve
+
+    p = Problem(M=40, N=40)
+    specs = [None, Ellipse(cx=0.1, rx=0.7, ry=0.4),
+             Rectangle(-0.6, -0.3, 0.5, 0.3),
+             SDF(lambda x, y: x * x + y * y - 0.2, name="circ-test")]
+    gates = [1.0, 1.1, 0.9, 1.3]
+    res = solve_batched(p, rhs_gates=gates, geometries=specs)
+    for i, (g, gate) in enumerate(zip(specs, gates)):
+        seq = pcg_solve(p, geometry=g, rhs_gate=gate)
+        assert int(res.iterations[i]) == int(seq.iterations), i
+        assert np.array_equal(np.asarray(res.w[i]), np.asarray(seq.w)), i
+
+
+def test_two_families_one_bucket_executable():
+    """The acceptance criterion, from the counters: a second geometry
+    family on the same grid is a canvas-cache MISS but a bucket-cache
+    HIT — new canvases, no recompile."""
+    from poisson_tpu.obs import metrics
+    from poisson_tpu.solvers.batched import (
+        reset_bucket_cache,
+        solve_batched,
+    )
+
+    metrics.reset()
+    reset_bucket_cache()
+    reset_geometry_cache()
+    p = Problem(M=24, N=24)
+    fam_a = Ellipse(cx=0.0, rx=0.8, ry=0.45)
+    fam_b = Rectangle(-0.5, -0.4, 0.7, 0.35)
+    ra = solve_batched(p, rhs_gates=[1.0] * 3, geometries=[fam_a] * 3)
+    assert metrics.get("batched.bucket_cache.misses") == 1
+    rb = solve_batched(p, rhs_gates=[1.0] * 3, geometries=[fam_b] * 3)
+    assert metrics.get("batched.bucket_cache.hits") == 1
+    assert metrics.get("batched.bucket_cache.misses") == 1
+    assert metrics.get("geom.cache.misses") == 2   # one bake per family
+    assert metrics.get("geom.cache.hits") >= 2     # members reuse it
+    assert np.all(np.asarray(ra.flag) == 1)
+    assert np.all(np.asarray(rb.flag) == 1)
+
+
+def test_geometry_none_batch_is_classic_path_bitwise():
+    from poisson_tpu.solvers.batched import solve_batched
+
+    p = Problem(M=24, N=24)
+    classic = solve_batched(p, rhs_gates=[1.0, 1.3])
+    geo = solve_batched(p, rhs_gates=[1.0, 1.3], geometries=[None, None])
+    assert np.array_equal(np.asarray(classic.w), np.asarray(geo.w))
+    assert np.array_equal(np.asarray(classic.iterations),
+                          np.asarray(geo.iterations))
+
+
+def test_geometries_length_mismatch_rejected():
+    from poisson_tpu.solvers.batched import solve_batched
+
+    p = Problem(M=16, N=16)
+    with pytest.raises(ValueError, match="one entry per member"):
+        solve_batched(p, rhs_gates=[1.0, 1.0],
+                      geometries=[DEFAULT_ELLIPSE])
+
+
+def test_multi_geometry_lanes_splice_and_retire_bitwise():
+    from poisson_tpu.solvers.lanes import LaneBatch
+    from poisson_tpu.solvers.pcg import pcg_solve
+
+    p = Problem(M=32, N=32)
+    lanes = LaneBatch(p, 2, chunk=10, multi_geometry=True)
+    g_a = Ellipse(cx=0.1, rx=0.7, ry=0.4)
+    lanes.splice("default", 1.0)
+    lanes.splice("ell-a", 1.0, geometry=g_a)
+    for _ in range(40):
+        lanes.step()
+        if all(v["done"] or v["member_id"] is None
+               for v in lanes.lane_view()):
+            break
+    r0, r1 = lanes.retire(0), lanes.retire(1)
+    s0, sa = pcg_solve(p), pcg_solve(p, geometry=g_a)
+    assert r0.iterations == int(s0.iterations)
+    assert np.array_equal(np.asarray(r0.w), np.asarray(s0.w))
+    assert r1.iterations == int(sa.iterations)
+    assert np.array_equal(np.asarray(r1.w), np.asarray(sa.w))
+    # Splice a NEW family into the freed lane of the same programs.
+    g_b = Rectangle(-0.5, -0.3, 0.6, 0.35)
+    lanes.splice("rect-b", 1.0, geometry=g_b)
+    for _ in range(40):
+        lanes.step()
+        if all(v["done"] or v["member_id"] is None
+               for v in lanes.lane_view()):
+            break
+    rb = lanes.retire(lanes.origin.index("rect-b"))
+    sb = pcg_solve(p, geometry=g_b)
+    assert rb.iterations == int(sb.iterations)
+    assert np.array_equal(np.asarray(rb.w), np.asarray(sb.w))
+
+
+def test_single_geometry_lane_batch_rejects_geometry_splice():
+    from poisson_tpu.solvers.lanes import LaneBatch
+
+    lanes = LaneBatch(Problem(M=16, N=16), 1, chunk=5)
+    with pytest.raises(ValueError, match="multi_geometry"):
+        lanes.splice("m", 1.0, geometry=DEFAULT_ELLIPSE)
+
+
+# -- serve integration --------------------------------------------------
+
+
+def test_service_mixed_geometry_both_engines():
+    from poisson_tpu.serve import (
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.serve.types import SCHED_CONTINUOUS
+    from poisson_tpu.testing.chaos import VirtualClock, _quiet_degradation
+
+    p = Problem(M=40, N=40)
+    specs = [Ellipse(cx=0.1, rx=0.7, ry=0.4),
+             Rectangle(-0.6, -0.3, 0.5, 0.3), None]
+    for sched in (None, SCHED_CONTINUOUS):
+        vc = VirtualClock()
+        kw = {"scheduling": sched, "refill_chunk": 10} if sched else {}
+        svc = SolveService(
+            ServicePolicy(capacity=16,
+                          degradation=_quiet_degradation(), **kw),
+            clock=vc, sleep=vc.sleep)
+        for i in range(6):
+            svc.submit(SolveRequest(request_id=i, problem=p,
+                                    geometry=specs[i % 3],
+                                    rhs_gate=1.0 + i / 10))
+        outs = svc.drain()
+        assert len(outs) == 6 and all(o.converged for o in outs), sched
+        assert svc.stats()["lost"] == 0
+
+
+def test_geometry_requests_carry_fingerprint_in_flight_trace(tmp_path):
+    from poisson_tpu import obs
+    from poisson_tpu.obs.trace import load_events
+    from poisson_tpu.serve import (
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.chaos import VirtualClock, _quiet_degradation
+
+    obs.configure(trace_dir=str(tmp_path))
+    try:
+        p = Problem(M=24, N=24)
+        g = Ellipse(cx=0.1, rx=0.6, ry=0.3)
+        vc = VirtualClock()
+        svc = SolveService(
+            ServicePolicy(capacity=4,
+                          degradation=_quiet_degradation()),
+            clock=vc, sleep=vc.sleep)
+        svc.submit(SolveRequest(request_id="geo", problem=p, geometry=g))
+        svc.drain()
+        obs.finalize()
+        events = load_events(str(tmp_path))
+    finally:
+        obs.shutdown()
+    resident = [e for e in events
+                if e.get("name") == "flight.span"
+                and (e.get("attrs") or {}).get("span") == "lane_resident"]
+    assert resident, "no residency span emitted"
+    assert any((e.get("attrs") or {}).get("geometry") == g.fingerprint
+               for e in resident), resident
+
+
+def test_geometry_divergence_never_escalates_to_resilient():
+    """The resilient escalation driver solves the reference domain; a
+    geometry request's divergence retry must stay on the geometry-aware
+    dispatch path (escalate stays False)."""
+    from poisson_tpu.serve.service import SolveService, _Entry
+    from poisson_tpu.serve.types import (
+        ERROR_DIVERGENCE,
+        ServicePolicy,
+        SolveRequest,
+    )
+    from poisson_tpu.testing.chaos import VirtualClock
+
+    vc = VirtualClock()
+    svc = SolveService(ServicePolicy(), clock=vc, sleep=vc.sleep)
+    p = Problem(M=16, N=16)
+    geo_entry = _Entry(SolveRequest(request_id="g", problem=p,
+                                    geometry=DEFAULT_ELLIPSE), 0.0, None)
+    plain_entry = _Entry(SolveRequest(request_id="p", problem=p),
+                         0.0, None)
+    svc._retry_or_fail(geo_entry, ERROR_DIVERGENCE, "boom", set())
+    svc._retry_or_fail(plain_entry, ERROR_DIVERGENCE, "boom", set())
+    assert geo_entry.escalate is False
+    assert plain_entry.escalate is True
+
+
+def test_journal_replays_geometry_requests(tmp_path):
+    from poisson_tpu.serve.journal import SolveJournal, replay_journal
+    from poisson_tpu.serve.types import SolveRequest
+
+    path = str(tmp_path / "geo.journal")
+    j = SolveJournal(path)
+    p = Problem(M=16, N=16)
+    g = Ellipse(cx=0.2, rx=0.5, ry=0.3)
+    j.submit(SolveRequest(request_id="geo-1", problem=p, geometry=g),
+             "trace-1")
+    j.record("requeue", request_id="geo-1", attempt=1, error="transient",
+             recovered=False, taint=["other"], taint_fp=["gdeadbeef"])
+    j.close()
+    replay = replay_journal(path)
+    (pend,) = replay.pending
+    assert pend.request.geometry is not None
+    assert pend.request.geometry.fingerprint == g.fingerprint
+    assert pend.taint_fp == {"gdeadbeef"}
+
+
+# -- random-polygon sweep (seeded, alongside test_random_geometry.py) ---
+
+
+def _random_polygons(n: int):
+    rng = np.random.RandomState(20260804)
+    out = []
+    for _ in range(n):
+        k = int(rng.randint(3, 8))
+        # A star-shaped simple polygon: random radii at sorted angles
+        # around a random interior center, kept inside the solve box.
+        cx = float(rng.uniform(-0.25, 0.25))
+        cy = float(rng.uniform(-0.12, 0.12))
+        ang = np.sort(rng.uniform(0.0, 2 * np.pi, size=k))
+        rad = rng.uniform(0.18, 0.42, size=k)
+        verts = tuple(
+            (float(cx + r * np.cos(a)), float(cy + 0.55 * r * np.sin(a)))
+            for a, r in zip(ang, rad))
+        out.append(Polygon(verts))
+    return out
+
+
+@pytest.mark.parametrize("poly", _random_polygons(5))
+def test_random_polygon_canvases_and_solve(poly):
+    p = Problem(M=48, N=48)
+    a, b, rhs = build_geometry_fields(p, poly)
+    # Canvas sanity: coefficients within the blend bounds, and the
+    # vertical-face lengths implied by a agree with an independent
+    # dense-membership estimate of the face fraction.
+    assert a.min() >= 1.0 - 1e-12 and b.min() >= 1.0 - 1e-12
+    assert a.max() <= 1.0 / p.eps + 1e-9
+    i, j = p.M // 2, p.N // 2            # a face near the center
+    x = p.x_min + i * p.h1 - 0.5 * p.h1
+    ys = p.y_min + j * p.h2 - 0.5 * p.h2 + np.linspace(0, p.h2, 4001)
+    frac = float(poly.contains(np.full_like(ys, x), ys, np).mean())
+    ell = frac * p.h2
+    blend = ell / p.h2 + (1 - ell / p.h2) / p.eps
+    got = a[i, j]
+    want = (1.0 if abs(ell - p.h2) < 1e-9
+            else (1.0 / p.eps if ell < 1e-9 else blend))
+    # The dense estimate quantizes ℓ at h/4000; 1/eps amplification
+    # keeps this loose but a misclassified face fails at O(1/eps).
+    assert got == pytest.approx(want, rel=0, abs=2.0), (got, want)
+    # The solve: converges, finite, zero on the Dirichlet ring, and the
+    # fictitious-domain solution is small outside the polygon.
+    from poisson_tpu.solvers.pcg import pcg_solve
+
+    res = pcg_solve(p, geometry=poly)
+    w = np.asarray(res.w)
+    assert int(res.flag) == 1, poly
+    assert np.isfinite(w).all()
+    assert abs(w[0, :]).max() == 0 and abs(w[:, 0]).max() == 0
+    xs = (p.x_min + np.arange(p.M + 1) * p.h1)[:, None]
+    ys2 = (p.y_min + np.arange(p.N + 1) * p.h2)[None, :]
+    inside = poly.contains(xs, ys2, np)
+    if inside.any() and (~inside).any():
+        assert abs(w[~inside]).max() <= max(1e-3,
+                                            0.15 * abs(w[inside]).max())
+
+
+# -- sentinel cohort pins -----------------------------------------------
+
+
+def test_regress_geometry_mix_splits_cohorts():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "regress", pathlib.Path(__file__).resolve().parents[1]
+        / "benchmarks" / "regress.py")
+    regress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(regress)
+
+    def rec(geometry_mix, value):
+        return regress.record_from_result({
+            "metric": "serve.sustained_solves_per_sec",
+            "value": value,
+            "detail": {"grid": [96, 144], "dtype": "float32",
+                       "backend": "xla_serve", "devices": 1,
+                       "platform": "cpu", "fault_load": "clean",
+                       "arrival_rate": 60.0,
+                       **({"geometry_mix": geometry_mix}
+                          if geometry_mix else {})},
+        }, source="test")
+
+    mixed = rec(4, 20.0)
+    clean = rec(None, 60.0)
+    assert regress.cohort_key(mixed) != regress.cohort_key(clean)
+    assert regress.cohort_key(rec(4, 25.0)) == regress.cohort_key(mixed)
+    # A slow mixed run among fast single-ellipse baselines must NOT
+    # alarm: the cohorts never meet.
+    records = [rec(None, 60.0 + i) for i in range(4)] + [mixed]
+    verdict = regress.evaluate(records)
+    assert all(r["classification"] != "regression"
+               for r in verdict["records"]), verdict
+
+
+def test_chaos_campaign_includes_geometry_scenario():
+    from poisson_tpu.testing import chaos
+
+    assert "geometry-mixed-cobatch" in chaos.scenario_names()
+    rep = chaos.run_scenario("geometry-mixed-cobatch", seed=0)
+    assert rep["ok"], rep["checks"]
+    assert rep["invariant"]["lost"] == 0
+    assert len(chaos.scenario_names()) >= 21
+
+
+# -- shape gradients ----------------------------------------------------
+
+
+def test_shape_gradient_matches_finite_differences():
+    import jax.numpy as jnp
+
+    from poisson_tpu.solvers.adjoint import (
+        differentiable_geometry_solve,
+        shape_gradient,
+    )
+
+    # Tight delta: the FD probe differences two solves, so solver
+    # tolerance must sit far below the probe step.
+    p = Problem(M=32, N=32, delta=1e-11)
+    loss = lambda w: jnp.sum(w[1:-1, 1:-1]) * p.h1 * p.h2
+    spec_fn = lambda q: Ellipse(cx=0.0, cy=0.0, rx=q[0], ry=q[1])
+    params = jnp.asarray([0.8, 0.42])
+    val, grad = shape_gradient(p, spec_fn, params, loss)
+    assert np.isfinite(float(val)) and np.isfinite(np.asarray(grad)).all()
+    eps = 1e-5
+
+    def f(q):
+        return float(loss(differentiable_geometry_solve(
+            p, spec_fn(jnp.asarray(q)))))
+
+    for k in range(2):
+        hi = [0.8, 0.42]
+        lo = [0.8, 0.42]
+        hi[k] += eps
+        lo[k] -= eps
+        fd = (f(hi) - f(lo)) / (2 * eps)
+        assert float(grad[k]) == pytest.approx(fd, rel=5e-3), (k, fd)
+
+
+def test_shape_gradient_rejects_sampled_families():
+    from poisson_tpu.solvers.adjoint import differentiable_geometry_solve
+
+    with pytest.raises(ValueError, match="closed-form"):
+        differentiable_geometry_solve(
+            Problem(M=16, N=16),
+            Polygon(((0.0, 0.0), (0.4, 0.0), (0.2, 0.3))))
+
+
+# -- CLI ----------------------------------------------------------------
+
+
+def test_cli_geometry_subcommand(capsys):
+    from poisson_tpu.cli import main
+
+    rc = main(["geometry",
+               '{"type":"ellipse","rx":0.7,"ry":0.4}', "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["fingerprint"].startswith("g")
+    assert out["inside_nodes"] > 0 and out["cut_faces"] > 0
+    rc = main(["geometry", '{"type":"rect","x0":-0.5,"y0":-0.3,'
+               '"x1":0.5,"y1":0.3}', "--height", "10"])
+    assert rc == 0
+    rendered = capsys.readouterr().out
+    assert "#" in rendered and "fingerprint" in rendered
+
+
+def test_cli_geometry_flag_on_solve(capsys):
+    from poisson_tpu.cli import main
+
+    rc = main(["24", "24", "--geometry",
+               '{"type":"ellipse","rx":0.7,"ry":0.4}', "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["l2_error"] is None       # the ellipse oracle is not it
+    assert rep["iterations"] > 0
+
+
+def test_cli_geometry_flag_rejections(capsys):
+    from poisson_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["24", "24", "--geometry", "{bad json"])
+    with pytest.raises(SystemExit, match="xla"):
+        main(["24", "24", "--backend", "native", "--geometry",
+              '{"type":"ellipse"}'])
+
+
+def test_cli_solve_batched_geometry_mix(capsys):
+    from poisson_tpu.cli import main
+
+    rc = main(["solve-batched", "24", "24", "--batch", "4",
+               "--geometry", '{"type":"ellipse","rx":0.7,"ry":0.4}',
+               "--geometry",
+               '{"type":"rect","x0":-0.5,"y0":-0.3,"x1":0.5,"y1":0.3}',
+               "--compare-sequential", "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["geometry_mix"] == 2
+    assert len(rep["geometries"]) == 2
+    assert rep["iterations_match_sequential"] is True
+    assert rep["converged"] == 4
