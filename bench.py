@@ -1025,6 +1025,118 @@ def _serve_bench(problem, requests: int, devices, platform: str,
     return 0 if stats["lost"] == 0 else 1
 
 
+def _verify_bench(problem, verify_every: int, devices, platform: str,
+                  downgraded: bool = False) -> int:
+    """Integrity-probe overhead mode (``--verify-every K``): the SAME
+    slope methodology as the headline bench, run over BOTH arms — the
+    unverified baseline and the verified solve — in one process and
+    emitted as ONE record. The headline value is the VERIFIED arm's
+    MLUPS; ``detail.verify_every`` joins the regression sentinel's
+    cohort key (direction-pinned: a verified run can never indict an
+    unverified baseline — benchmarks/regress.py), and
+    ``detail.verify_overhead`` carries both arms so the overhead claim
+    in BENCH.md is always reproducible from the artifact."""
+    import jax.numpy as jnp
+
+    from poisson_tpu import obs
+    from poisson_tpu.solvers.pcg import pcg_solve, resolve_verify_tol
+    from poisson_tpu.utils.timing import fence, mlups
+
+    dtype = jnp.float32
+
+    def base_run(gate=None):
+        return pcg_solve(problem, dtype=dtype, rhs_gate=gate)
+
+    def ver_run(gate=None):
+        return pcg_solve(problem, dtype=dtype, rhs_gate=gate,
+                         verify_every=verify_every)
+
+    with obs.span("bench.verify_warmup", fence=False,
+                  verify_every=verify_every):
+        t0 = time.perf_counter()
+        base = base_run()
+        fence(base)
+        ver = ver_run()
+        fence(ver)
+        compile_and_first = time.perf_counter() - t0
+    obs.inc("time.compile_seconds", compile_and_first)
+
+    def chain(run, k: int) -> float:
+        t0 = time.perf_counter()
+        res = run()
+        for _ in range(k - 1):
+            gate = 1.0 + 0.0 * res.diff.astype(jnp.float32)
+            res = run(gate)
+        fence(res.iterations)
+        return time.perf_counter() - t0
+
+    with obs.span("bench.verify_timed", fence=False,
+                  verify_every=verify_every):
+        tb = (min(chain(base_run, K_HI) for _ in range(3))
+              - min(chain(base_run, K_LO) for _ in range(3)))
+        tv = (min(chain(ver_run, K_HI) for _ in range(3))
+              - min(chain(ver_run, K_LO) for _ in range(3)))
+    if tb <= 0 or tv <= 0:
+        print(f"bench: non-positive slope (baseline {tb:.4f}s, verified "
+              f"{tv:.4f}s); falling back to whole-chain timing",
+              file=sys.stderr)
+        # Normalize the whole-chain fallback to the slope's per-solve
+        # denominator (K_HI solves vs the per = K_HI - K_LO divisor
+        # below), or an arm that fell back reads ~K_HI/per too slow —
+        # and an asymmetric fallback would skew overhead_fraction.
+        if tb <= 0:
+            tb = chain(base_run, K_HI) * (K_HI - K_LO) / K_HI
+        if tv <= 0:
+            tv = chain(ver_run, K_HI) * (K_HI - K_LO) / K_HI
+    per = K_HI - K_LO
+    base_s, ver_s = tb / per, tv / per
+    base_mlups = mlups(problem, int(base.iterations), base_s)
+    ver_mlups = mlups(problem, int(ver.iterations), ver_s)
+    overhead = (round(max(0.0, 1.0 - ver_mlups / base_mlups), 4)
+                if base_mlups > 0 else None)
+    record = {
+        "metric": "mlups",
+        "value": round(ver_mlups, 1),
+        "unit": "MLUPS",
+        "detail": {
+            "grid": [problem.M, problem.N],
+            "iterations": int(ver.iterations),
+            "iterations_baseline": int(base.iterations),
+            "solve_seconds": round(ver_s, 4),
+            "first_run_seconds": round(compile_and_first, 2),
+            "dtype": jnp.dtype(dtype).name,
+            "backend": "xla",
+            "devices": len(devices),
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "platform_fallback": downgraded,
+            # Experiment identity for the sentinel: verified runs form
+            # their own cohort (regress.cohort_key) so the probe's
+            # overhead can never read as a regression of the unverified
+            # baseline — and vice versa.
+            "verify_every": verify_every,
+            "verify_overhead": {
+                "verify_tol": resolve_verify_tol(
+                    None, jnp.dtype(dtype).name),
+                "baseline_mlups": round(base_mlups, 1),
+                "verified_mlups": round(ver_mlups, 1),
+                "baseline_solve_seconds": round(base_s, 4),
+                "verified_solve_seconds": round(ver_s, 4),
+                "overhead_fraction": overhead,
+                "checks_per_solve": int(ver.iterations) // verify_every,
+            },
+        },
+    }
+    obs.gauge("bench.verify_overhead_fraction", overhead)
+    obs.event("bench.verify_record", grid=f"{problem.M}x{problem.N}",
+              verify_every=verify_every, mlups=record["value"],
+              baseline_mlups=round(base_mlups, 1),
+              overhead_fraction=overhead)
+    obs.finalize()
+    print(json.dumps(record))
+    return 0
+
+
 def main() -> int:
     downgraded, probe_failures = _acquire_backend()
     _adopt_layout_decision()
@@ -1091,6 +1203,20 @@ def main() -> int:
         argv = argv[:i] + argv[i + 2:]
         if batch < 1:
             print(f"--batch must be >= 1, got {batch}", file=sys.stderr)
+            return 2
+    verify_every_arg = None
+    if "--verify-every" in argv:
+        i = argv.index("--verify-every")
+        try:
+            verify_every_arg = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python bench.py --verify-every K [M N]",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if verify_every_arg < 1:
+            print(f"--verify-every must be >= 1, got {verify_every_arg}",
+                  file=sys.stderr)
             return 2
     serve_requests = None
     if "--serve" in argv:
@@ -1185,11 +1311,17 @@ def main() -> int:
         print("--batch and --serve are separate bench modes; pick one",
               file=sys.stderr)
         return 2
+    if verify_every_arg is not None and (batch is not None
+                                         or serve_requests is not None):
+        print("--verify-every is its own bench mode; drop --batch/--serve",
+              file=sys.stderr)
+        return 2
     if len(argv) == 2:
         problem = Problem(M=int(argv[0]), N=int(argv[1]))
     elif len(argv) == 0:
         problem = (Problem(M=400, N=600)
                    if batch is not None or serve_requests is not None
+                   or verify_every_arg is not None
                    else Problem(M=800, N=1200))
     else:
         print("usage: python bench.py [--batch B | --serve R] [M N]",
@@ -1226,6 +1358,9 @@ def main() -> int:
             signal.signal(signal.SIGALRM, prev)
     platform = devices[0].platform
 
+    if verify_every_arg is not None:
+        return _verify_bench(problem, verify_every_arg, devices, platform,
+                             downgraded=downgraded)
     if batch is not None:
         return _batched_bench(problem, batch, devices, platform,
                               downgraded=downgraded)

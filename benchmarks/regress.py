@@ -44,7 +44,13 @@ sustained throughput is never compared against single-worker baselines
 (``--serve R --geometry-mix K``) carry ``detail.geometry_mix`` in the
 cohort key: a K-family mixed load solves K different operators per
 bucket, so its sustained number never judges a single-ellipse baseline
-(pinned by tests/test_geometry_dsl.py).
+(pinned by tests/test_geometry_dsl.py). Integrity-verified records
+(``bench.py --verify-every K``) carry ``detail.verify_every`` in the
+cohort key — the direction pin for the SDC defense: a solve paying the
+in-loop verification probe is a different experiment from an unverified
+one, so a verified run can never indict an unverified baseline and an
+unverified run can never mask a verified-path slowdown (pinned by
+tests/test_integrity.py).
 
 Stdlib only, no jax import: like the forensics renderer, a post-session
 gate must never risk initializing a backend.
@@ -91,6 +97,7 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                arrival_rate: Optional[float] = None,
                workers: Optional[int] = None,
                geometry_mix: Optional[int] = None,
+               verify_every: Optional[int] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -120,6 +127,11 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # the family count is experiment identity — a K-domain mixed
         # load never judges a single-ellipse baseline. Cohort key too.
         "geometry_mix": geometry_mix,
+        # Integrity-verified records (bench.py --verify-every K): the
+        # probe stride is experiment identity — a verified solve pays
+        # for its drift checks by design, so it never indicts an
+        # unverified baseline (and cannot hide behind one). Cohort key.
+        "verify_every": verify_every,
         "failed": bool(failed),
         "note": note,
     }
@@ -155,6 +167,7 @@ def record_from_result(result: dict, source: str,
         arrival_rate=det.get("arrival_rate"),
         workers=det.get("workers"),
         geometry_mix=det.get("geometry_mix"),
+        verify_every=det.get("verify_every"),
     )
 
 
@@ -244,17 +257,18 @@ def cohort_key(rec: dict):
     """Records are only ever compared inside this key: same metric, same
     grid, same dtype, same platform/backend/device-count — and, for
     service-mode records, the same injected fault load, the same
-    open-loop arrival rate, the same fleet worker count, AND the same
-    geometry-mix family count (fault-load runs are never judged against
-    clean baselines; throughput at one offered load is a different
-    experiment from another; a W-worker fleet never judges a
-    single-worker baseline; a K-family mixed-geometry load never judges
-    a single-ellipse one)."""
+    open-loop arrival rate, the same fleet worker count, the same
+    geometry-mix family count, AND the same integrity-probe stride
+    (fault-load runs are never judged against clean baselines;
+    throughput at one offered load is a different experiment from
+    another; a W-worker fleet never judges a single-worker baseline; a
+    K-family mixed-geometry load never judges a single-ellipse one; a
+    verified solve never indicts an unverified baseline)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
             rec.get("devices"), rec.get("fault_load"),
             rec.get("arrival_rate"), rec.get("workers"),
-            rec.get("geometry_mix"))
+            rec.get("geometry_mix"), rec.get("verify_every"))
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

@@ -213,9 +213,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="abort with diagnostics if no chunk completes "
                         "within this window (first chunk includes "
                         "compile time — size generously)")
+    r.add_argument("--verify-every", type=int, default=0, metavar="K",
+                   help="in-loop integrity probe (poisson_tpu.integrity, "
+                        "--backend xla): every K iterations (and on "
+                        "every convergence event) recompute the true "
+                        "residual ||b-Aw|| and stop with an 'integrity' "
+                        "verdict when it drifts from the recurrence — "
+                        "silent-data-corruption detection; with "
+                        "--resilient the recovery is a verified restart. "
+                        "0 (default) traces no probe: the program is "
+                        "byte-identical and golden counts bit-for-bit")
+    r.add_argument("--verify-tol", type=float, default=None,
+                   help="relative drift tolerance for --verify-every "
+                        "(default: dtype-aware — 1e-6 f64, 2e-5 f32)")
     r.add_argument("--fault-nan-at", type=int, default=None, metavar="K",
                    help="fault injection: poison the residual with a NaN "
                         "at the first chunk boundary at/after iteration K")
+    r.add_argument("--fault-bitflip-at", default=None,
+                   metavar="ITER[:BUF[:BIT]]",
+                   help="fault injection: flip one storage bit of buffer "
+                        "BUF (w/r/p/z/Ap; default w) at the first chunk "
+                        "boundary at/after ITER — finite, SILENT "
+                        "corruption the NaN rail cannot see; only "
+                        "--verify-every detects it (drill: --resilient "
+                        "--verify-every 5 --fault-bitflip-at 100)")
     r.add_argument("--fault-preempt-after", type=int, default=None,
                    metavar="CHUNKS",
                    help="fault injection: simulate preemption (exit code "
@@ -342,14 +363,38 @@ def _resilience_kit(args):
 
         watchdog = Watchdog(heartbeat_path=args.heartbeat,
                             timeout=args.watchdog_timeout)
-    on_chunk = None
+    hooks = []
     if args.fault_nan_at is not None or args.fault_preempt_after is not None:
         from poisson_tpu.testing.faults import FaultPlan, chunk_hook
 
-        on_chunk = chunk_hook(FaultPlan(
+        hooks.append(chunk_hook(FaultPlan(
             nan_at_iteration=args.fault_nan_at,
             preempt_after_chunks=args.fault_preempt_after,
-        ))
+        )))
+    if getattr(args, "fault_bitflip_at", None):
+        from poisson_tpu.testing.faults import (
+            bitflip_hook,
+            parse_bitflip_spec,
+        )
+
+        it, buf, bit = parse_bitflip_spec(args.fault_bitflip_at)
+        hooks.append(bitflip_hook(it, buffer=buf, bit=bit))
+    if not hooks:
+        on_chunk = None
+    elif len(hooks) == 1:
+        on_chunk = hooks[0]
+    else:
+        def on_chunk(state, chunks_done):
+            # Chain the chunk hooks (faults compose: a NaN drill and a
+            # bit-flip drill may both be armed); each sees the previous
+            # hook's mutation, None means "no change" per the contract.
+            changed = None
+            for hook in hooks:
+                new = hook(changed if changed is not None else state,
+                           chunks_done)
+                if new is not None:
+                    changed = new
+            return changed
     return watchdog, on_chunk
 
 
@@ -550,6 +595,7 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
             checkpoint_path=args.checkpoint, keep_last=args.keep_last,
             stream_every=stream_every,
             watchdog=watchdog, on_chunk=on_chunk,
+            verify_every=args.verify_every, verify_tol=args.verify_tol,
         )
         n_dev = 1
     elif args.checkpoint:
@@ -561,6 +607,7 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
             stagnation_window=args.stagnation_window or 0,
             stream_every=stream_every,
             watchdog=watchdog, on_chunk=on_chunk,
+            verify_every=args.verify_every, verify_tol=args.verify_tol,
         )
         n_dev = 1
     else:
@@ -569,7 +616,9 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
         geom = (_parse_geometry_arg(args.geometry)
                 if getattr(args, "geometry", None) else None)
         run = lambda: pcg_solve(problem, dtype=args.dtype,
-                                stream_every=stream_every, geometry=geom)
+                                stream_every=stream_every, geometry=geom,
+                                verify_every=args.verify_every,
+                                verify_tol=args.verify_tol)
         n_dev = 1
 
     from poisson_tpu import obs
@@ -718,6 +767,15 @@ def build_batched_parser() -> argparse.ArgumentParser:
                         "repeatable — members round-robin across the "
                         "specs and DIFFERENT geometries co-batch in the "
                         "one bucket executable (poisson_tpu.geometry)")
+    p.add_argument("--verify-every", type=int, default=0, metavar="K",
+                   help="per-member in-loop integrity probe "
+                        "(poisson_tpu.integrity): a silently corrupted "
+                        "member stops alone with an 'integrity' verdict "
+                        "while its batchmates solve on; 0 (default) "
+                        "keeps the historical executables byte-for-byte")
+    p.add_argument("--verify-tol", type=float, default=None,
+                   help="relative drift tolerance for --verify-every "
+                        "(default: dtype-aware)")
     p.add_argument("--repeat", type=int, default=1,
                    help="timed batched-solve repetitions; report the best")
     p.add_argument("--compare-sequential", action="store_true",
@@ -778,9 +836,17 @@ def _main_solve_batched(argv) -> int:
         specs = [_parse_geometry_arg(s) for s in args.geometry]
         geometries = [specs[i % len(specs)] for i in range(B)]
 
+    if args.verify_every < 0:
+        raise SystemExit(f"--verify-every must be >= 0, "
+                         f"got {args.verify_every}")
+    if args.verify_tol is not None and not args.verify_every:
+        raise SystemExit("--verify-tol tunes the integrity probe; pass "
+                         "--verify-every K to arm it")
     run = lambda: solve_batched(problem, rhs_gates=gates,
                                 dtype=args.dtype, bucket=args.bucket,
-                                geometries=geometries)
+                                geometries=geometries,
+                                verify_every=args.verify_every,
+                                verify_tol=args.verify_tol)
     timer = PhaseTimer()
     with timer.phase("compile_and_first_solve"):
         result = run()
@@ -809,6 +875,8 @@ def _main_solve_batched(argv) -> int:
         "converged": converged,
         "flags": sorted({FLAG_NAMES.get(f, str(f)) for f in flags}),
     }
+    if args.verify_every:
+        record["verify_every"] = args.verify_every
     if geometries is not None:
         record["geometry_mix"] = len(args.geometry)
         record["geometries"] = sorted({g.fingerprint for g in geometries})
@@ -924,6 +992,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "(recovered taint/backoff path) and drained to "
                         "their one typed outcome (--requests 0 runs "
                         "recovery alone)")
+    p.add_argument("--verify-every", type=int, default=0, metavar="K",
+                   help="always-on in-loop integrity verification for "
+                        "every dispatch (ServicePolicy.integrity): "
+                        "silent-data-corruption detections become typed "
+                        "'integrity' retries with suspect-cohort taint; "
+                        "0 (default) arms the probe only defensively, "
+                        "after a first detection taints the hardware "
+                        "cohort")
+    p.add_argument("--verify-tol", type=float, default=None,
+                   help="relative drift tolerance for the integrity "
+                        "probe (default: dtype-aware)")
     p.add_argument("--seed", type=int, default=0,
                    help="backoff-jitter / load RNG seed (default 0)")
     p.add_argument("--fault-poison", type=int, default=0, metavar="K",
@@ -1010,6 +1089,11 @@ def _main_serve(argv) -> int:
         t_start = time.monotonic()
         worker_fault = kill_worker_at(
             args.kill_worker_at, lambda: time.monotonic() - t_start)
+    if args.verify_every < 0:
+        raise SystemExit(f"--verify-every must be >= 0, "
+                         f"got {args.verify_every}")
+    from poisson_tpu.integrity import IntegrityPolicy
+
     policy = ServicePolicy(
         capacity=args.capacity, max_batch=args.max_batch,
         default_chunk=args.chunk or 50,
@@ -1017,6 +1101,8 @@ def _main_serve(argv) -> int:
                     else SCHED_DRAIN),
         refill_chunk=args.refill_chunk,
         fleet=FleetPolicy(workers=args.workers),
+        integrity=IntegrityPolicy(verify_every=args.verify_every,
+                                  verify_tol=args.verify_tol),
     )
     journal = (SolveJournal(args.journal) if args.journal else None)
     if args.recover:
@@ -1086,6 +1172,15 @@ def _main_serve(argv) -> int:
                             stats["latency_seconds"].items()},
         "breakers": stats["breakers"],
     }
+    if args.verify_every or _metrics.get("serve.integrity.detections"):
+        record["integrity"] = {
+            "verify_every": args.verify_every,
+            "detections": _metrics.get("serve.integrity.detections"),
+            "retries": _metrics.get("serve.integrity.retries"),
+            "suspect_cohorts": _metrics.get(
+                "serve.integrity.suspect_cohorts"),
+            "errors": _metrics.get("serve.errors.integrity"),
+        }
     if args.workers > 1 or args.kill_worker_at is not None:
         record["fleet"] = {
             "workers": {str(k): v for k, v in stats["workers"].items()},
@@ -1292,8 +1387,13 @@ def _main_chaos(argv) -> int:
     from poisson_tpu.testing import chaos
 
     if args.list:
-        for name in chaos.scenario_names():
-            print(name)
+        # Grouped by subsystem: the flat list outgrew readability at
+        # ~20 scenarios. Names stay one-per-line (indented) so shell
+        # pipelines (grep/awk) keep working on the name column.
+        for group, names in chaos.scenario_groups().items():
+            print(f"{group}:")
+            for name in names:
+                print(f"  {name}")
         return 0
     if args.all and args.scenarios:
         raise SystemExit("give scenario names or --all, not both")
@@ -1411,16 +1511,33 @@ def main(argv=None) -> int:
 
     enable_from_env()
     problem = _problem(args)
+    bitflip_at = None
+    if args.fault_bitflip_at:
+        from poisson_tpu.testing.faults import parse_bitflip_spec
+
+        try:
+            bitflip_at, _, _ = parse_bitflip_spec(args.fault_bitflip_at)
+        except ValueError as e:
+            raise SystemExit(f"--fault-bitflip-at: {e}")
     if args.chunk is None:
-        # The NaN drill injects at the first chunk BOUNDARY at/after K; a
-        # solve that converges inside chunk one would never reach it, so
-        # the default chunk shrinks to make the drill actually fire. An
-        # explicit --chunk is always honored (chunking never changes the
-        # iterate sequence, only where the boundaries land).
-        args.chunk = (min(200, max(1, args.fault_nan_at))
-                      if args.fault_nan_at is not None else 200)
+        # The NaN/bit-flip drills inject at the first chunk BOUNDARY
+        # at/after K; a solve that converges inside chunk one would
+        # never reach it, so the default chunk shrinks to make the
+        # drill actually fire. An explicit --chunk is always honored
+        # (chunking never changes the iterate sequence, only where the
+        # boundaries land).
+        inject_ats = [k for k in (args.fault_nan_at, bitflip_at)
+                      if k is not None]
+        args.chunk = (min(200, max(1, min(inject_ats)))
+                      if inject_ats else 200)
     elif args.chunk < 1:
         raise SystemExit(f"--chunk must be >= 1, got {args.chunk}")
+    if args.verify_every < 0:
+        raise SystemExit(f"--verify-every must be >= 0, "
+                         f"got {args.verify_every}")
+    if args.verify_tol is not None and not args.verify_every:
+        raise SystemExit("--verify-tol tunes the integrity probe; pass "
+                         "--verify-every K to arm it")
     if args.stream_every < 0:
         raise SystemExit(f"--stream-every must be >= 0, "
                          f"got {args.stream_every}")
@@ -1457,6 +1574,8 @@ def main(argv=None) -> int:
         or args.fault_nan_at is not None
         or args.fault_preempt_after is not None
         or args.fault_corrupt_checkpoint is not None
+        or args.fault_bitflip_at is not None
+        or args.verify_every != 0
     )
     if resilience_flags and args.backend == "native":
         raise SystemExit(
@@ -1558,6 +1677,19 @@ def main(argv=None) -> int:
                 "--fault-nan-at/--fault-preempt-after inject at chunk "
                 "boundaries; use --resilient, or --checkpoint with "
                 f"--backend xla or sharded (resolved backend: {backend})"
+            )
+        if args.fault_bitflip_at is not None and not (
+                args.resilient or (args.checkpoint and backend == "xla")):
+            raise SystemExit(
+                "--fault-bitflip-at injects at chunk boundaries of the "
+                "single-device drivers; use --resilient, or --checkpoint "
+                f"with --backend xla (resolved backend: {backend})"
+            )
+        if args.verify_every and backend != "xla":
+            raise SystemExit(
+                "--verify-every arms the in-loop integrity probe in the "
+                "fused XLA solvers; use --backend xla (resolved "
+                f"backend: {backend})"
             )
         if (args.heartbeat or args.watchdog_timeout is not None) \
                 and not hookable:

@@ -194,9 +194,9 @@ def manufactured_error(case: ManufacturedCase, M: int, N: int,
         rhs_use = rhs64
         aux64 = np.pad(d64, 1)
     dt = jnp.dtype(dtype_name)
-    result = _solve(problem, use_scaled, 0, jnp.asarray(a64, dt),
-                    jnp.asarray(b64, dt), jnp.asarray(rhs_use, dt),
-                    jnp.asarray(aux64, dt))
+    result = _solve(problem, use_scaled, 0, 0, 0.0, False,
+                    jnp.asarray(a64, dt), jnp.asarray(b64, dt),
+                    jnp.asarray(rhs_use, dt), jnp.asarray(aux64, dt))
 
     w = np.asarray(result.w, np.float64)
     i_idx = np.arange(problem.M + 1)

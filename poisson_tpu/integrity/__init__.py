@@ -1,0 +1,76 @@
+"""Numerical-integrity layer: silent-data-corruption defense.
+
+Every robustness layer in this framework so far defends against *loud*
+failures — NaNs (PR 1), preemptions and crashes (PR 8), hangs (the
+watchdog). A single flipped mantissa or exponent bit in a PCG buffer
+produces none of those: the recurrence residual keeps shrinking while
+the iterate silently converges to the wrong answer, which is exactly
+the failure mode fleet-scale hardware exhibits (Hochschild et al.,
+*Cores that don't count*, HotOS 2021 — PAPERS.md). The classic answer
+is algorithm-based fault tolerance (Huang & Abraham 1984): Krylov
+methods carry cheap invariants whose violation *detects* corruption for
+a few percent of overhead, and the recovery rails this repo already has
+(the PR 1 restart driver, the serve layer's retry/taint machinery) are
+exactly the right response — they just never had a detector to fire
+them. This package is that detector, plus the policy object that
+threads it through the stack:
+
+- **The invariants** (:mod:`poisson_tpu.integrity.probe`): the
+  true-vs-recurrence residual drift ``‖(b − A w) − r‖`` (zero in exact
+  arithmetic, O(ε)-small in floating point, large after a storage flip
+  in ``w`` or ``r`` or a corrupted stencil application landing in
+  ``r``), the convergence-jump guard (a search-direction flip makes
+  ``‖Δw‖`` collapse spuriously — a *false convergence* the residual
+  drift alone cannot see), and an optional checksum-row ABFT identity
+  on the stencil application (``Σ(Ap) = (A·1)ᵀp`` by symmetry — the
+  compute-corruption complement to the storage checks).
+- **In-loop verification**: ``verify_every=K`` threads the drift probe
+  into the fused ``while_loop`` bodies (``solvers.pcg`` /
+  ``solvers.batched`` / ``solvers.lanes``) — every K iterations, and on
+  every convergence event, the probe recomputes the true residual and
+  stamps ``FLAG_INTEGRITY`` on the member that drifted. Per-member in
+  batched/lane programs: only the corrupted member trips; its
+  batchmates never notice. The off switch follows the ``stream_every``
+  pattern: ``verify_every=0`` (the default everywhere) traces no probe
+  at all — the lowered HLO is byte-identical and golden iteration
+  counts are bit-for-bit (pinned by tests).
+- **Verified restart** (``solvers.resilient``): the driver carries a
+  *verified-good* snapshot — the newest chunk-boundary iterate that
+  passed the drift probe, distinct from checkpoint files — and a
+  ``FLAG_INTEGRITY`` stop restarts from it WITHOUT burning a precision
+  escalation (a bit flip is a hardware event, not a precision
+  problem). Detections that fail the driver's recheck are counted
+  ``integrity.false_alarms`` and resume without a restart.
+- **Service response** (``poisson_tpu.serve``): integrity failures are
+  a typed outcome class (``error_type="integrity"``) with retry +
+  escalation through the verified-restart driver, and the first
+  detection taints the (backend, device_kind) cohort as SDC-suspect —
+  subsequent dispatches on that cohort run with defensive verification
+  even when the policy default is off (``serve.integrity.*``).
+
+Counters (``obs.metrics``): ``integrity.checks`` / ``.detections`` /
+``.verified_restarts`` / ``.false_alarms``; ``serve.integrity.*`` on
+the service side.
+"""
+
+from poisson_tpu.integrity.probe import (
+    DEFAULT_VERIFY_JUMP,
+    IntegrityPolicy,
+    abft_colsum,
+    abft_drift_exceeds,
+    default_verify_tol,
+    drift_exceeds,
+    recheck_state,
+    residual_drift,
+)
+
+__all__ = [
+    "DEFAULT_VERIFY_JUMP",
+    "IntegrityPolicy",
+    "abft_colsum",
+    "abft_drift_exceeds",
+    "default_verify_tol",
+    "drift_exceeds",
+    "recheck_state",
+    "residual_drift",
+]

@@ -1,0 +1,224 @@
+"""The integrity invariants: cheap traced checks that detect silent
+data corruption inside a running PCG solve.
+
+Three invariants, each exact in exact arithmetic and O(ε)-small in
+clean floating point:
+
+1. **Residual drift** — CG carries the residual by recurrence
+   (``r ← r − αAp``) and never recomputes it; after a storage flip in
+   ``w`` or ``r`` (or a corrupted ``Ap`` landing in ``r``) the
+   recurrence and the true residual ``b − Aw`` silently part ways while
+   the recurrence keeps shrinking. ``‖(b − Aw) − r‖`` measures exactly
+   that gap, for the price of one extra stencil application per check.
+2. **Update-norm anomalies** — a magnitude-increasing flip in the
+   search direction ``p`` keeps the recurrence CONSISTENT (both ``w``
+   and ``r`` are updated with the same corrupted direction) but
+   collapses ``α`` and with it the update norm ``‖Δw‖`` by the flip's
+   own gain factor. Two guards see it: the *convergence-jump* guard (a
+   collapse that crosses δ is a false convergence — genuine CG
+   convergence is gradual, the best ``‖Δw‖`` approaches δ before
+   crossing it) and the *collapse* guard (a one-step ‖Δw‖ drop beyond
+   :data:`DEFAULT_VERIFY_COLLAPSE` without converging — clean CG
+   one-step drops measure ≤ 1.4×). Both compare scalars already in the
+   state: no extra device work.
+3. **Checksum-row ABFT** (optional, Huang & Abraham 1984) — by symmetry
+   of the stencil operator, ``Σ_interior(Ap) = (A·𝟙)ᵀ p`` with the
+   column-sum vector ``A·𝟙`` precomputed once outside the loop. A
+   transient corruption *inside* the stencil application (the
+   compute-unit failure mode, invisible to the storage checks until it
+   propagates) breaks the identity immediately.
+
+All checks are relative: drift is compared against
+``tol · max(‖r‖, ‖b‖)`` so one tolerance serves every grid size and
+RHS magnitude. Default tolerances are dtype-aware
+(:func:`default_verify_tol`) and sized for zero false alarms on the
+golden solves (asserted in tests) while an exponent-class flip lands
+orders of magnitude above the line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+# The convergence-jump guard RATIO: a convergence event whose previous
+# best ‖Δw‖ sat more than this factor ABOVE the converging step's own
+# ‖Δw‖ is classified corrupt — the one-iteration collapse of a flipped
+# search direction (α shrinks with ‖p‖², the update norm with it; a
+# single exponent-bit flip collapses ‖Δw‖ by the 2^Δe of the flip).
+# Clean CG update norms decline gradually (per-iteration contraction
+# well under 10×, so the genuine final ratio is single digits — the
+# goldens measure ~1.4); 50 has an order of magnitude of margin on the
+# clean side while catching any collapse of 2^6 and up. Collapses
+# SMALLER than the ratio are self-limiting, not missed: stopping one
+# ×F step early costs at most ~F·δ in update-norm terms, which is why
+# the guard is a ratio and not a knife edge (README "Numerical
+# integrity" states the bounded-harm contract).
+DEFAULT_VERIFY_JUMP = 50.0
+
+# The mid-solve collapse guard RATIO: a one-iteration drop of ‖Δw‖ by
+# more than this factor WITHOUT a convergence event is a corrupted
+# search direction even when the iterate is nowhere near δ — the flip
+# inflates ‖p‖², α = ζ/(pᵀAp) collapses with it, and the update norm
+# falls by roughly the flip magnitude over the direction's own scale
+# while the recurrence stays CONSISTENT — the one corruption the
+# residual-drift invariant cannot see in principle. Clean CG one-step
+# drops measure ≤ 2.5× across the goldens and the geometry families
+# (f32 + f64, three grid sizes); the collapse a silent exponent flip
+# produces grows as the direction decays under the flip's structural
+# cap — ≥ 11× by mid-solve in scaled f32, 500×..10⁶× unscaled f64
+# (measured). 8 sits a ≥3× margin above clean and under every
+# mid-solve signal. EARLY f32 flips (a decayed direction is what makes
+# the ratio large) can land inside CG's own dynamic range — that
+# regime is the bounded-harm contract: the recurrence is consistent,
+# so the solve provably converges to the correct answer, merely
+# slower (asserted in tests). Checked every iteration when verifying —
+# two scalars already in the state, no extra device work.
+DEFAULT_VERIFY_COLLAPSE = 8.0
+
+# Relative drift tolerances by state dtype. Clean recurrence-vs-true
+# drift grows like O(k·ε·κ-ish); these sit far above the clean floor
+# measured on the golden problems (tests pin zero false alarms, f32 and
+# f64) and far below any exponent-class corruption (relative drift
+# ≳ 1).
+_VERIFY_TOLS = {
+    "float64": 1e-6,
+    # f32 runs the diagonally-scaled system, where residual entries are
+    # tiny and a SILENT exponent flip is structurally capped near O(1)
+    # absolute (reaching a huge value needs a high exponent bit clear,
+    # which means the value was already astronomically small — the
+    # product stays moderate; anything bigger overflows the first
+    # square and the NaN rail catches it instead). Measured on the
+    # goldens: flip drift ≥ 2e-4 of the iterate scale, clean floor
+    # ≤ ~5e-7 through 300 iterations — 2e-5 sits an order of magnitude
+    # under the weakest modeled flip and a multiple above the floor.
+    "float32": 2e-5,
+    "bfloat16": 5e-2,
+}
+
+
+def default_verify_tol(dtype_name: str) -> float:
+    """The dtype-aware default relative drift tolerance."""
+    return _VERIFY_TOLS.get(str(jnp.dtype(dtype_name).name), 1e-3)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegrityPolicy:
+    """The numerical-integrity knobs, threaded through solvers and the
+    solve service (``ServicePolicy.integrity``).
+
+    verify_every: in-loop verification stride — every this many
+        iterations (and on every convergence event) the fused loop
+        recomputes the true residual and compares it against the
+        recurrence residual, stamping FLAG_INTEGRITY on drift. 0 (the
+        default) traces no probe at all: the compiled program is
+        byte-identical to an unverified build and golden iteration
+        counts are bit-for-bit.
+    verify_tol: relative drift tolerance (None: the dtype-aware
+        :func:`default_verify_tol`).
+    verify_on_suspect: service-side defense escalation — once any
+        dispatch on a (backend, device_kind) cohort trips an integrity
+        detection, later dispatches on that cohort run with
+        ``suspect_verify_every`` even when ``verify_every`` is 0. A
+        core that miscomputed once is the textbook mercurial core
+        (Hochschild et al. 2021); paying the probe overhead only after
+        the first strike is the cheap middle ground between
+        always-on and never.
+    suspect_verify_every: the stride used for suspect cohorts (and for
+        integrity-escalated retries through the resilient driver).
+    abft: additionally trace the checksum-row ABFT identity on the
+        stencil application at each probe (single-device solve paths).
+    """
+
+    verify_every: int = 0
+    verify_tol: Optional[float] = None
+    verify_on_suspect: bool = True
+    suspect_verify_every: int = 25
+    abft: bool = False
+
+
+def residual_drift(ops, w, r, rhs):
+    """The drift invariant as traced squared norms: returns
+    ``(drift_sq, scale_sq)`` where ``drift_sq = ‖(rhs − Aw) − r‖²`` and
+    ``scale_sq = max(‖r‖², ‖rhs‖², ‖w‖²)``. Batch-polymorphic
+    (per-member trailing-axes reductions via the ops bundle).
+    Corruption is ``drift_sq > tol² · scale_sq`` — compare squared to
+    skip the sqrt.
+
+    The iterate norm belongs in the scale: the attainable gap between
+    the recurrence and the true residual in clean floating point is
+    O(k·ε·‖A‖·‖w‖) (Greenbaum), NOT O(ε·‖r‖) — near convergence the
+    recurrence keeps shrinking while the gap floor does not, so a
+    residual-relative scale would false-alarm on any long clean f32
+    solve (measured: the 400×600 golden drifts to ~2e-2 of ‖b‖ by
+    iteration 546). Relative to ‖w‖ the clean floor stays at O(k·ε)
+    while exponent-class corruption still lands orders of magnitude
+    above the tolerance — and a drift that is small *relative to the
+    solution* is also the one that cannot hurt the answer."""
+    true_r = rhs - ops.apply_A(ops.exchange(w))
+    drift_sq = ops.sqnorm(true_r - r)
+    scale_sq = jnp.maximum(jnp.maximum(ops.sqnorm(r), ops.sqnorm(rhs)),
+                           ops.sqnorm(w))
+    return drift_sq, scale_sq
+
+
+def drift_exceeds(ops, w, r, rhs, tol):
+    """True iff the residual drift exceeds ``tol`` relative to the
+    residual/RHS/iterate scale. The tiny floor keeps an all-zero member
+    (an EMPTY lane, a padding member) from dividing 0 by 0.
+
+    A non-finite drift or scale is itself a corruption verdict: an
+    exponent-class flip can push ``‖w‖²`` (or the drift itself) past
+    overflow, and ``drift > tol²·inf`` would read False — the probe
+    would go blind on exactly the largest corruptions. Overflowing a
+    squared norm is not something a converging solve's buffers do."""
+    drift_sq, scale_sq = residual_drift(ops, w, r, rhs)
+    tol = jnp.asarray(tol, drift_sq.dtype)
+    floor = jnp.asarray(jnp.finfo(drift_sq.dtype).tiny, drift_sq.dtype)
+    exceeded = drift_sq > tol * tol * jnp.maximum(scale_sq, floor)
+    blown = ~(jnp.isfinite(drift_sq) & jnp.isfinite(scale_sq))
+    return exceeded | blown
+
+
+def abft_colsum(ops, like):
+    """The checksum row ``A·𝟙`` (interior indicator, zero Dirichlet
+    ring), precomputed once outside the loop. ``like`` supplies the
+    grid shape/dtype."""
+    ones = jnp.zeros_like(like)
+    ones = ones.at[..., 1:-1, 1:-1].set(1.0)
+    return ops.apply_A(ops.exchange(ones))
+
+
+def abft_drift_exceeds(colsum, p, Ap, tol):
+    """True iff the stencil application broke the checksum-row identity
+    ``Σ(Ap) = (A·𝟙)ᵀp`` beyond ``tol`` relative to the magnitude of the
+    sum actually formed (``Σ|colsum·p|`` — the cancellation-aware
+    scale: the identity's two sides are sums of the same products)."""
+    lhs = jnp.sum(Ap, axis=(-2, -1))
+    prod = colsum * p
+    rhs = jnp.sum(prod, axis=(-2, -1))
+    scale = jnp.sum(jnp.abs(prod), axis=(-2, -1))
+    tol = jnp.asarray(tol, scale.dtype)
+    floor = jnp.asarray(jnp.finfo(scale.dtype).tiny, scale.dtype)
+    return jnp.abs(lhs - rhs) > tol * jnp.maximum(scale, floor)
+
+
+def recheck_state(ops, w, r, rhs, tol):
+    """Host-decision recheck of a stopped state: recompute the drift
+    invariant outside the loop and return ``(confirmed, drift_rel)`` —
+    the resilient driver's false-alarm classifier. A detection whose
+    recheck does not reproduce (and whose stop was not a
+    convergence-jump verdict) is counted ``integrity.false_alarms``
+    and the solve resumes from the very state that fired it."""
+    import math
+
+    drift_sq, scale_sq = residual_drift(ops, w, r, rhs)
+    floor = jnp.finfo(jnp.asarray(drift_sq).dtype).tiny
+    drift_rel = float(jnp.sqrt(drift_sq)
+                      / jnp.sqrt(jnp.maximum(scale_sq, floor)))
+    # A non-finite ratio is an overflowed buffer — confirmed, not an
+    # artifact (NaN > tol would read False and clear a real hit).
+    confirmed = (not math.isfinite(drift_rel)) or drift_rel > float(tol)
+    return confirmed, drift_rel

@@ -18,6 +18,31 @@ Naming convention (dotted, low cardinality):
 - ``checkpoint.writes`` / ``checkpoint.crc_failures`` /
   ``checkpoint.corrupt`` / ``checkpoint.generation_fallbacks``;
 - ``watchdog.beats`` / ``watchdog.stalls``;
+- ``integrity.*`` — the numerical-integrity layer
+  (``poisson_tpu.integrity``, the silent-data-corruption defense):
+  ``integrity.checks`` counts chunk-boundary drift verifications run by
+  the resilient driver (one extra stencil application each; the in-loop
+  probe's per-iteration checks are fused device work and deliberately
+  uncounted), ``integrity.detections`` counts confirmed FLAG_INTEGRITY
+  verdicts, ``integrity.verified_restarts`` counts recoveries that
+  restarted from the last *verified-good* snapshot (never a precision
+  escalation — a bit flip is a hardware event, not an arithmetic one),
+  and ``integrity.false_alarms`` counts detections the driver's
+  host-side recheck could not reproduce (the solve resumes from the
+  very state that fired; a misfiring detector costs one recheck, never
+  a restart). Read ``false_alarms`` next to ``detections``: a nonzero
+  ratio on clean fleets means the drift tolerance is mis-sized;
+- ``serve.integrity.*`` — the solve service's SDC response
+  (``ServicePolicy.integrity``): ``serve.integrity.detections``
+  (FLAG_INTEGRITY members classified), ``serve.integrity.retries``
+  (typed ``integrity`` retries issued),
+  ``serve.integrity.suspect_cohorts`` (distinct (backend, device_kind)
+  hardware cohorts tainted SDC-suspect by a first detection — cohorts,
+  not detections), and ``serve.integrity.suspect_dispatches``
+  (dispatches that ran DEFENSIVE verification only because their
+  cohort was suspect — the cost of paying the probe after the first
+  strike instead of always); terminal failures land in
+  ``serve.errors.integrity`` beside the other typed error classes;
 - ``multihost.init_retries`` / ``multihost.degraded``;
 - ``time.compile_seconds`` / ``time.execute_seconds`` (accumulating
   float counters: compile vs execute wall time);

@@ -29,7 +29,12 @@ surviving exposition. Step 15 (last of all, clean registry) proves
 geometry-as-a-request: two geometry families built → a rebuild is a
 fingerprint-cache hit → both families co-batch in ONE bucket executable
 (geom miss + bucket hit on the second family — zero recompiles) → the
-``geom_*`` counters survive exposition.
+``geom_*`` counters survive exposition. Step 16 (runs LAST of all,
+clean registry) proves the silent-data-corruption defense
+(``poisson_tpu.integrity``): a clean verified solve → zero detections
+and the golden iteration count; a seeded exponent bit-flip mid-solve →
+detection → verified restart → convergence with zero false alarms; the
+``integrity_*`` and ``serve_integrity_*`` counters survive exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -469,6 +474,65 @@ def run_selfcheck(out_dir: str) -> int:
             return _fail(f"exposition lost the {prom_name} counter")
     geom_hits = obs_metrics.get("geom.cache.hits")
 
+    # 16. Numerical integrity (runs LAST of all, clean registry): the
+    # silent-data-corruption defense end to end — a clean verified
+    # solve detects nothing and keeps the golden count; a seeded
+    # exponent bit flip mid-solve is detected by the in-loop drift
+    # probe and recovered by a verified restart (no precision burned,
+    # no false alarms); a serve-side SDC chaos scenario keeps the
+    # ledger invariant; and the integrity_*/serve_integrity_* counters
+    # survive the Prometheus exposition round trip.
+    import warnings as _warnings
+
+    from poisson_tpu.solvers.resilient import pcg_solve_resilient
+    from poisson_tpu.testing.faults import bitflip_per_solve_hook
+
+    obs_metrics.reset()
+    clean = pcg_solve_resilient(problem, chunk=10, verify_every=5)
+    if (int(clean.iterations) != int(baseline.iterations)
+            or not clean.restarts == 0):
+        return _fail(
+            f"verified clean solve drifted from the golden: "
+            f"{int(clean.iterations)} iters (golden "
+            f"{int(baseline.iterations)}), restarts {clean.restarts}")
+    if obs_metrics.get("integrity.detections") != 0 \
+            or obs_metrics.get("integrity.false_alarms") != 0:
+        return _fail(
+            f"clean verified solve raised integrity verdicts: "
+            f"detections={obs_metrics.get('integrity.detections')}, "
+            f"false_alarms={obs_metrics.get('integrity.false_alarms')}")
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore", RuntimeWarning)
+        flipped = pcg_solve_resilient(
+            problem, chunk=10, verify_every=5,
+            on_chunk=bitflip_per_solve_hook(20, buffer="w", seed=1))
+    from poisson_tpu.solvers.pcg import FLAG_CONVERGED as _FC
+
+    if int(flipped.flag) != _FC or not flipped.restarts:
+        return _fail(f"bit-flipped solve did not recover: flag "
+                     f"{int(flipped.flag)}, restarts {flipped.restarts}")
+    detections = obs_metrics.get("integrity.detections")
+    vrestarts = obs_metrics.get("integrity.verified_restarts")
+    if (detections < 1 or vrestarts < 1
+            or obs_metrics.get("integrity.false_alarms") != 0):
+        return _fail(
+            f"integrity counters missed the flip: detections="
+            f"{detections}, verified_restarts={vrestarts}, false_alarms="
+            f"{obs_metrics.get('integrity.false_alarms')}")
+    sdc_report = chaos.run_scenario("sdc-verified-restart", seed=0)
+    if not sdc_report["ok"]:
+        failed = [k for k, v in sdc_report["checks"].items() if not v]
+        return _fail(f"chaos scenario sdc-verified-restart failed: "
+                     f"{failed}")
+    integ_parsed = export.parse_text(
+        export.render(sdc_report["metrics_snapshot"]))
+    for prom_name in ("poisson_tpu_integrity_detections",
+                      "poisson_tpu_integrity_verified_restarts",
+                      "poisson_tpu_serve_integrity_detections",
+                      "poisson_tpu_serve_integrity_suspect_cohorts"):
+        if prom_name not in integ_parsed:
+            return _fail(f"exposition lost the {prom_name} counter")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -481,7 +545,10 @@ def run_selfcheck(out_dir: str) -> int:
           f"buckets), solve fleet ok ({int(quarantines)} quarantine, "
           f"{int(recovered)} recovered, journal replay agrees), "
           f"geometry ok ({int(geom_hits)} canvas-cache hits, mixed "
-          f"co-batch on one executable) ({out_dir})")
+          f"co-batch on one executable), integrity ok "
+          f"({int(detections)} detection -> {int(vrestarts)} verified "
+          f"restart, 0 false alarms, sdc-verified-restart green) "
+          f"({out_dir})")
     return 0
 
 

@@ -10,6 +10,14 @@ and poisoned-member bucket isolation, a circuit breaker per
 ladder — every mechanism audible as ``serve.*`` counters/spans
 (``poisson_tpu.obs``) and exportable to Prometheus (``obs.export``).
 
+PR 10 gave it a silent-data-corruption defense
+(``poisson_tpu.integrity``): integrity failures are a typed
+``integrity`` outcome class with retry through the verified-restart
+driver, and the first detection taints the (backend, device_kind)
+hardware cohort as SDC-suspect so later dispatches on it run
+defensively verified (``ServicePolicy.integrity``,
+``serve.integrity.*`` counters).
+
 PR 8 made the service *durable*: a supervised worker fleet
 (``serve.fleet`` — sticky executables, per-worker breakers, heartbeat
 watchdogs, quarantine → warm-up restart) and a CRC-sealed write-ahead
@@ -53,8 +61,10 @@ from poisson_tpu.serve.service import (
     p99_exemplar,
     slowest_requests,
 )
+from poisson_tpu.integrity.probe import IntegrityPolicy
 from poisson_tpu.serve.types import (
     ERROR_DIVERGENCE,
+    ERROR_INTEGRITY,
     ERROR_INTERNAL,
     ERROR_TRANSIENT,
     OUTCOME_ERROR,
@@ -78,8 +88,10 @@ from poisson_tpu.serve.types import (
 
 __all__ = [
     "BreakerPolicy", "CircuitBreaker", "CLOSED", "Deadline",
-    "DegradationPolicy", "ERROR_DIVERGENCE", "ERROR_INTERNAL",
-    "ERROR_TRANSIENT", "FleetPolicy", "HALF_OPEN", "JournalReplay",
+    "DegradationPolicy", "ERROR_DIVERGENCE", "ERROR_INTEGRITY",
+    "ERROR_INTERNAL",
+    "ERROR_TRANSIENT", "FleetPolicy", "HALF_OPEN", "IntegrityPolicy",
+    "JournalReplay",
     "OPEN", "Outcome", "OUTCOME_ERROR",
     "OUTCOME_RESULT", "OUTCOME_SHED", "PendingRequest", "RetryPolicy",
     "SCHED_CONTINUOUS", "SCHED_DRAIN", "ServicePolicy",

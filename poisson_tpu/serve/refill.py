@@ -49,14 +49,22 @@ class LaneTable:
 
     def __init__(self, cohort: str, problem, dtype, bucket: int,
                  chunk: int, worker_id: int = 0,
-                 multi_geometry: bool = False):
+                 multi_geometry: bool = False, verify_every: int = 0,
+                 verify_tol=None):
         self.cohort = cohort
         self.problem = problem
         self.worker_id = worker_id
         self.multi_geometry = bool(multi_geometry)
+        # The per-lane integrity probe (poisson_tpu.integrity): decided
+        # at table construction like multi_geometry — an occupied
+        # program's operand signature can never change, so a service
+        # turning defensive verification on (suspect-cohort taint)
+        # applies it to the NEXT table, never retrofits a running one.
+        self.verify_every = int(verify_every)
         self.batch = LaneBatch(
             problem, bucket, dtype=dtype, chunk=chunk,
             multi_geometry=multi_geometry,
+            verify_every=verify_every, verify_tol=verify_tol,
             # Chunk-boundary hook (solvers.lanes): each boundary is a
             # timeline event, so a wedged lane program's last boundary
             # is on disk for forensics — attributed to the worker that

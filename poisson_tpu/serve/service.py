@@ -91,6 +91,7 @@ from poisson_tpu.serve.fleet import (
 )
 from poisson_tpu.serve.types import (
     ERROR_DIVERGENCE,
+    ERROR_INTEGRITY,
     ERROR_INTERNAL,
     ERROR_TRANSIENT,
     OUTCOME_ERROR,
@@ -235,6 +236,13 @@ class SolveService:
         self._latencies: List[float] = []
         self._counts = {"admitted": 0, "completed": 0, "errors": 0,
                         "shed": 0, "recovered": 0}
+        # SDC-suspect hardware cohorts (poisson_tpu.integrity): the
+        # (backend, device_kind) pairs on which an integrity detection
+        # has already fired. With integrity.verify_on_suspect, later
+        # dispatches on a tainted cohort run defensively verified even
+        # when the policy default is off — a core that miscomputed once
+        # is the textbook mercurial core (Hochschild et al. 2021).
+        self._suspect_hw: set = set()
         # The worker pool: N dispatch contexts over this one queue and
         # ledger (serve.fleet; workers=1 is the classic single-worker
         # service — same scheduling decisions, same golden outcomes).
@@ -593,6 +601,56 @@ class SolveService:
         # the mixed-geometry co-batching seam.
         return base + (":geo" if request.geometry is not None else "")
 
+    def _hw_cohort(self) -> tuple:
+        """The (backend, device_kind) pair integrity suspicion taints —
+        hardware identity, not request identity: a bit flip indicts the
+        part it ran on, and every request cohort sharing that part
+        inherits the suspicion (cached: device identity cannot change
+        inside one process)."""
+        if not hasattr(self, "_hw_cohort_cache"):
+            import jax
+
+            dev = jax.devices()[0]
+            self._hw_cohort_cache = (
+                "xla", str(getattr(dev, "device_kind", dev.platform)))
+        return self._hw_cohort_cache
+
+    def _verify_params(self, entries=()) -> tuple:
+        """The (verify_every, verify_tol) the next dispatch touching
+        ``entries`` should run with: the policy's always-on stride when
+        set; else — with ``verify_on_suspect`` — the defensive
+        ``suspect_verify_every`` when this process's hardware cohort is
+        already SDC-suspect or any entry is an integrity-class retry
+        (its redo must be able to defend itself). (0, None) means no
+        probe is traced: the flag-off executables are the exact
+        historical programs."""
+        pol = self.policy.integrity
+        if pol.verify_every > 0:
+            return int(pol.verify_every), pol.verify_tol
+        suspect_retry = any(e.last_failure == ERROR_INTEGRITY
+                            for e in entries)
+        if pol.verify_on_suspect and (
+                suspect_retry or self._hw_cohort() in self._suspect_hw):
+            return int(pol.suspect_verify_every), pol.verify_tol
+        return 0, None
+
+    def _count_defensive_verify(self, verify_every: int) -> None:
+        """A dispatch armed the probe only because of suspicion (the
+        policy default is off) — the audible record of paying the
+        defense after the first strike."""
+        if verify_every and not self.policy.integrity.verify_every:
+            obs.inc("serve.integrity.suspect_dispatches")
+
+    def _taint_suspect_hw(self) -> None:
+        """First integrity detection on this hardware cohort: taint it.
+        Idempotent — the counter counts cohorts, not detections."""
+        cohort = self._hw_cohort()
+        if cohort not in self._suspect_hw:
+            self._suspect_hw.add(cohort)
+            obs.inc("serve.integrity.suspect_cohorts")
+            obs.event("serve.integrity.suspect_cohort",
+                      backend=cohort[0], device_kind=cohort[1])
+
     def _breaker(self, worker: Worker, cohort: str) -> CircuitBreaker:
         """The ``worker``'s breaker for ``cohort``: breaker state is
         keyed per worker cohort (a wedged worker trips its own breakers,
@@ -790,18 +848,24 @@ class SolveService:
             # is audible as serve.refill.idle_lane_steps.
             bucket = bucket_size(
                 min(max(ready + 1, 2), self.policy.max_batch))
+        verify_every, verify_tol = self._verify_params([head])
         table = worker.table
         # An in-flight program is immutable (fixed executable width); an
-        # EMPTY one is replaceable — on cohort change, or to re-size the
-        # bucket to the backlog the load has grown (or shrunk) into.
+        # EMPTY one is replaceable — on cohort change, to re-size the
+        # bucket to the backlog the load has grown (or shrunk) into, or
+        # when the integrity-probe stride changed (suspicion arrived:
+        # the NEXT program runs defended; a live one is never
+        # retrofitted).
         if table is not None and not table.occupied() and (
                 table.cohort != head_cohort
                 or table.problem != head.request.problem
-                or table.bucket != bucket):
+                or table.bucket != bucket
+                or table.verify_every != verify_every):
             table = worker.table = None
         if table is None:
             if level >= 1:
                 obs.inc("serve.degraded.padding")
+            self._count_defensive_verify(verify_every)
             eff_dtype = self._effective_dtype(head, level)
             table = worker.table = LaneTable(
                 head_cohort, head.request.problem,
@@ -809,6 +873,7 @@ class SolveService:
                 bucket, self.policy.refill_chunk,
                 worker_id=worker.id,
                 multi_geometry=head.request.geometry is not None,
+                verify_every=verify_every, verify_tol=verify_tol,
             )
             self._note_sticky(worker, head_cohort, head.request.problem,
                               None if eff_dtype == "auto" else eff_dtype,
@@ -1128,6 +1193,8 @@ class SolveService:
         # Geometry cohorts dispatch with per-member canvases — mixed
         # fingerprints share the one stacked-canvas bucket executable.
         geoms = [e.request.geometry for e in batch]
+        verify_every, verify_tol = self._verify_params(batch)
+        self._count_defensive_verify(verify_every)
         result = solve_batched(
             problem,
             rhs_gates=[e.request.rhs_gate for e in batch],
@@ -1136,6 +1203,7 @@ class SolveService:
             bucket=(len(batch) if exact_bucket else None),
             geometries=(geoms if any(g is not None for g in geoms)
                         else None),
+            verify_every=verify_every, verify_tol=verify_tol,
         )
         co_ids = {e.request.request_id for e in batch}
         co_fps = _geo_fps(batch)
@@ -1187,19 +1255,32 @@ class SolveService:
             solo_problem = problem.with_(
                 f_val=problem.f_val * req.rhs_gate)
         rid = req.request_id
+        verify_every, verify_tol = self._verify_params([entry])
+        self._count_defensive_verify(verify_every)
         if entry.escalate and self.policy.retry.escalate_divergence:
             obs.inc("serve.escalations")
             try:
+                # An integrity-class escalation rides the SAME resilient
+                # driver as divergence — with the probe armed it IS the
+                # verified-restart driver (restart from the last
+                # verified-good iterate, no precision escalation); a
+                # persistent detector exhausting the restart budget
+                # surfaces as DivergenceError below, typed by the
+                # entry's failure class.
                 result = pcg_solve_resilient(
                     solo_problem, dtype=dtype, chunk=chunk,
                     deadline=entry.deadline, on_chunk=req.on_chunk,
+                    verify_every=verify_every, verify_tol=verify_tol,
                 )
             except DivergenceError as e:
                 secs = max(0.0, self._clock() - t_disp)
                 self._flight.add_step(rid, secs, 0, 0.0, did)
                 self._flight.end(rid, SPAN_RESIDENT,
                                  error="DivergenceError")
-                self._error(entry, ERROR_DIVERGENCE, str(e))
+                self._error(entry,
+                            (ERROR_INTEGRITY
+                             if entry.last_failure == ERROR_INTEGRITY
+                             else ERROR_DIVERGENCE), str(e))
                 return True
         else:
             result = pcg_solve_chunked(
@@ -1208,6 +1289,7 @@ class SolveService:
                 geometry=req.geometry,
                 rhs_gate=(req.rhs_gate if req.geometry is not None
                           else None),
+                verify_every=verify_every, verify_tol=verify_tol,
             )
         # Flight: a solo dispatch's whole wall is this member's compute
         # (it shares the program with nobody).
@@ -1234,6 +1316,7 @@ class SolveService:
         from poisson_tpu.solvers.pcg import (
             FLAG_CONVERGED,
             FLAG_DEADLINE,
+            FLAG_INTEGRITY,
             FLAG_NAMES,
             FLAG_NONE,
         )
@@ -1255,6 +1338,25 @@ class SolveService:
             self._complete(entry, "cap_hit", False, True, iterations,
                            restarts, diff)
             return False
+        if flag == FLAG_INTEGRITY:
+            # Silent-data-corruption verdict (poisson_tpu.integrity):
+            # its own outcome class — the iterate is suspect, not
+            # divergent, and the suspicion attaches to the HARDWARE
+            # cohort (Hochschild 2021), so later dispatches on this
+            # (backend, device_kind) run defensively verified even when
+            # the policy default is off. The member itself is retried
+            # (through the verified-restart resilient driver when it
+            # can escalate), typed ``integrity`` once the budget runs
+            # out.
+            obs.inc("serve.integrity.detections")
+            obs.event("serve.integrity.detection",
+                      request_id=str(entry.request.request_id),
+                      iteration=iterations)
+            self._taint_suspect_hw()
+            self._retry_or_fail(entry, ERROR_INTEGRITY,
+                                f"integrity verification failed at "
+                                f"iteration {iterations}", co_ids, co_fps)
+            return True
         # breakdown / nonfinite / stagnated: divergence-class failure.
         self._retry_or_fail(entry, ERROR_DIVERGENCE,
                             f"solver stopped: {name} at iteration "
@@ -1296,15 +1398,21 @@ class SolveService:
             if new_fps:
                 entry.taint_fp |= new_fps
                 obs.inc("serve.requeued.geometry_isolated")
-        # Divergence escalation runs the single-request resilient driver,
-        # which solves the reference geometry — a geometry request must
-        # not escalate into solving the wrong domain; it retries through
-        # the ordinary (geometry-aware) dispatch instead.
-        entry.escalate = (error_type == ERROR_DIVERGENCE
+        # Divergence AND integrity escalation run the single-request
+        # resilient driver — for an integrity retry that driver, with
+        # the probe armed by _verify_params, IS the verified-restart
+        # recovery path. It solves the reference geometry, so a
+        # geometry request must not escalate into solving the wrong
+        # domain; it retries through the ordinary (geometry-aware,
+        # defensively-verified) dispatch instead.
+        entry.escalate = (error_type in (ERROR_DIVERGENCE,
+                                         ERROR_INTEGRITY)
                           and self.policy.retry.escalate_divergence
                           and entry.request.geometry is None)
         entry.not_before = self._clock() + delay
         obs.inc("serve.retries")
+        if error_type == ERROR_INTEGRITY:
+            obs.inc("serve.integrity.retries")
         obs.inc("serve.backoff_seconds", delay)
         if co_ids:
             obs.inc("serve.requeued.isolated")

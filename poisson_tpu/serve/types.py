@@ -17,8 +17,11 @@ Outcome kinds:
 - ``error`` — the service gave up after its retry/escalation budget:
   ``error_type`` ∈ ``divergence`` (recovery exhausted, see
   ``solvers.resilient.DivergenceError``), ``transient`` (dispatch kept
-  failing — device fault, injected chaos), ``internal`` (a bug; never
-  retried, always surfaced).
+  failing — device fault, injected chaos), ``integrity`` (the in-loop
+  verification probe kept detecting silent data corruption —
+  ``poisson_tpu.integrity``; the first detection also taints the
+  (backend, device_kind) hardware cohort as SDC-suspect), ``internal``
+  (a bug; never retried, always surfaced).
 - ``shed`` — the service refused the work, by policy, with a reason:
   ``queue_full`` (bounded admission queue — overload never becomes
   unbounded memory growth), ``breaker_open`` (the request's cohort is
@@ -33,6 +36,7 @@ import dataclasses
 from typing import Callable, Optional, Union
 
 from poisson_tpu.config import Problem
+from poisson_tpu.integrity.probe import IntegrityPolicy
 
 OUTCOME_RESULT = "result"
 OUTCOME_ERROR = "error"
@@ -41,6 +45,7 @@ OUTCOME_SHED = "shed"
 ERROR_DIVERGENCE = "divergence"
 ERROR_TRANSIENT = "transient"
 ERROR_INTERNAL = "internal"
+ERROR_INTEGRITY = "integrity"
 
 SHED_QUEUE_FULL = "queue_full"
 SHED_BREAKER_OPEN = "breaker_open"
@@ -267,6 +272,17 @@ class ServicePolicy:
     smaller means fresher refill decisions and tighter deadline
     enforcement, at more host round-trips.
 
+    ``integrity`` is the silent-data-corruption defense
+    (:class:`~poisson_tpu.integrity.IntegrityPolicy`): with
+    ``verify_every`` > 0 every dispatch — batched, chunked solo, and
+    lane-table programs — runs the in-loop drift probe and a
+    FLAG_INTEGRITY member becomes a typed ``integrity`` retry; at the
+    default 0 the probe only arms *defensively*, after a first
+    detection has tainted the (backend, device_kind) hardware cohort
+    as SDC-suspect (``verify_on_suspect``/``suspect_verify_every``) —
+    the executables of an untainted flag-off service stay
+    byte-identical to every prior release.
+
     ``fleet`` sizes and supervises the worker pool (:class:`FleetPolicy`
     — ``workers=1`` is the single-worker service every prior PR ran).
     ``dedup`` makes submission idempotent: a second ``submit`` with an
@@ -288,3 +304,4 @@ class ServicePolicy:
     degradation: DegradationPolicy = DegradationPolicy()
     slo: SLOPolicy = SLOPolicy()
     fleet: FleetPolicy = FleetPolicy()
+    integrity: IntegrityPolicy = IntegrityPolicy()

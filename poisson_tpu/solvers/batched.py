@@ -88,7 +88,8 @@ def bucket_size(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
 
 def pcg_loop_batched(ops: PCGOps, rhs_stack, *, delta: float, max_iter: int,
                      weighted_norm: bool, h1: float, h2: float,
-                     stagnation_window: int = 0) -> PCGState:
+                     stagnation_window: int = 0, verify_every: int = 0,
+                     verify_tol: float = 0.0) -> PCGState:
     """Run the shared PCG body over a (B, M+1, N+1) RHS stack in ONE fused
     ``while_loop`` with per-member convergence masking.
 
@@ -102,12 +103,32 @@ def pcg_loop_batched(ops: PCGOps, rhs_stack, *, delta: float, max_iter: int,
     Streaming (``stream_every``) is deliberately not plumbed here: the
     host callback is per-iteration scalar telemetry and has no meaningful
     vmapped form; the batched path reports per-member outcomes instead.
+
+    ``verify_every`` > 0 arms the in-loop integrity probe PER MEMBER
+    (``poisson_tpu.integrity``): the body's pair form
+    (``make_pcg_member_body``) is vmapped with the RHS stack so every
+    member's true residual is checked against its OWN right-hand side —
+    a flipped bit stops only the corrupted member with FLAG_INTEGRITY;
+    its batchmates' trajectories are untouched (masked, like every
+    other per-member stop). At 0 the program is the exact historical
+    one.
     """
-    body = make_pcg_body(
-        ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
-        stagnation_window=stagnation_window,
-    )
-    vbody = jax.vmap(body)
+    if verify_every > 0:
+        from poisson_tpu.solvers.pcg import make_pcg_member_body
+
+        member = make_pcg_member_body(
+            ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
+            stagnation_window=stagnation_window,
+            verify_every=verify_every, verify_tol=verify_tol,
+        )
+        vpair = jax.vmap(member, in_axes=(0, 0))
+        vbody = lambda s: vpair(s, rhs_stack)
+    else:
+        body = make_pcg_body(
+            ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
+            stagnation_window=stagnation_window,
+        )
+        vbody = jax.vmap(body)
     init = jax.vmap(functools.partial(init_state, ops))(rhs_stack)
 
     def masked_body(s: PCGState) -> PCGState:
@@ -145,27 +166,52 @@ def pcg_step_batched_fields(problem: Problem, scaled: bool, a_stack,
                             b_stack, aux_stack, state: PCGState,
                             stop_at, *, delta: float,
                             weighted_norm: bool, h1: float,
-                            h2: float) -> PCGState:
+                            h2: float, verify_every: int = 0,
+                            verify_tol: float = 0.0,
+                            rhs_stack=None) -> PCGState:
     """Masked vmapped stepping over PER-MEMBER coefficient canvases:
     every member solves its OWN fictitious domain with the shared PCG
     body until it reaches ``stop_at`` — a scalar cap for the fused
     solve, a per-member stop line for the lane engine
     (:mod:`poisson_tpu.solvers.lanes`). Stopped/frozen members keep
     their state via per-member select, exactly like
-    :func:`pcg_loop_batched`."""
+    :func:`pcg_loop_batched`. ``verify_every`` > 0 arms the per-member
+    integrity probe (``rhs_stack`` — each member's OWN RHS — is then
+    required and vmapped alongside its canvases)."""
     member_ops = member_field_ops(problem, scaled)
 
-    def member_body(s: PCGState, a, b, aux) -> PCGState:
-        body = make_pcg_body(
-            member_ops(a, b, aux), delta=delta,
-            weighted_norm=weighted_norm, h1=h1, h2=h2,
-        )
-        return body(s)
+    if verify_every > 0:
+        from poisson_tpu.solvers.pcg import make_pcg_member_body
 
-    vbody = jax.vmap(member_body)
+        if rhs_stack is None:
+            raise ValueError("verify_every > 0 needs rhs_stack — the "
+                             "per-member probe checks each member's own "
+                             "true residual")
+
+        def member_body_v(s: PCGState, a, b, aux, rhs) -> PCGState:
+            body = make_pcg_member_body(
+                member_ops(a, b, aux), delta=delta,
+                weighted_norm=weighted_norm, h1=h1, h2=h2,
+                verify_every=verify_every, verify_tol=verify_tol,
+            )
+            return body(s, rhs)
+
+        vbody_v = jax.vmap(member_body_v)
+        step = lambda s: vbody_v(s, a_stack, b_stack, aux_stack,
+                                 rhs_stack)
+    else:
+        def member_body(s: PCGState, a, b, aux) -> PCGState:
+            body = make_pcg_body(
+                member_ops(a, b, aux), delta=delta,
+                weighted_norm=weighted_norm, h1=h1, h2=h2,
+            )
+            return body(s)
+
+        vbody = jax.vmap(member_body)
+        step = lambda s: vbody(s, a_stack, b_stack, aux_stack)
 
     def masked_body(s: PCGState) -> PCGState:
-        stepped = vbody(s, a_stack, b_stack, aux_stack)
+        stepped = step(s)
         frozen = s.done | (s.k >= stop_at)
 
         def keep(old, new):
@@ -184,7 +230,8 @@ def pcg_loop_batched_fields(problem: Problem, scaled: bool, a_stack,
                             b_stack, aux_stack, rhs_stack, *,
                             delta: float, max_iter: int,
                             weighted_norm: bool, h1: float,
-                            h2: float) -> PCGState:
+                            h2: float, verify_every: int = 0,
+                            verify_tol: float = 0.0) -> PCGState:
     """:func:`pcg_loop_batched` with PER-MEMBER coefficient canvases:
     a/b/aux carry a leading (B, …) axis and are vmapped alongside the
     state, so every member solves its OWN fictitious domain inside the
@@ -200,34 +247,43 @@ def pcg_loop_batched_fields(problem: Problem, scaled: bool, a_stack,
     )(rhs_stack, a_stack, b_stack, aux_stack)
     return pcg_step_batched_fields(
         problem, scaled, a_stack, b_stack, aux_stack, init, max_iter,
-        delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2)
+        delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
+        verify_every=verify_every, verify_tol=verify_tol,
+        rhs_stack=(rhs_stack if verify_every > 0 else None))
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _solve_batched_geo(problem: Problem, scaled: bool, a_stack, b_stack,
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _solve_batched_geo(problem: Problem, scaled: bool, verify_every: int,
+                       verify_tol: float, a_stack, b_stack,
                        rhs_stack, aux_stack) -> PCGResult:
     """jitted mixed-geometry batched solve: one executable per
     (bucket, grid, dtype, scaled) — the SAME executable no matter which
     geometries occupy the members (canvases are operands, never part of
     the jit key), which is what lets a second geometry family land as a
-    bucket-cache hit with zero recompiles."""
+    bucket-cache hit with zero recompiles. ``verify_every``/``verify_tol``
+    are the static per-member integrity-probe knobs (0 = the exact
+    historical program)."""
     s = pcg_loop_batched_fields(
         problem, scaled, a_stack, b_stack, aux_stack, rhs_stack,
         delta=problem.delta, max_iter=problem.iteration_cap,
         weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2,
+        verify_every=verify_every, verify_tol=verify_tol,
     )
     w = s.w * aux_stack if scaled else s.w   # per-member unscale
     return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr,
                      flag=s.flag, max_iterations=jnp.max(s.k))
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _solve_batched(problem: Problem, scaled: bool, a, b, rhs_stack,
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _solve_batched(problem: Problem, scaled: bool, verify_every: int,
+                   verify_tol: float, a, b, rhs_stack,
                    aux) -> PCGResult:
     """jitted batched solve over a (B, M+1, N+1) RHS stack; compiled once
     per (bucket, grid, dtype, scaled) — the executable every padded
-    request set of the same bucket reuses."""
+    request set of the same bucket reuses. ``verify_every``/``verify_tol``
+    are the static per-member integrity-probe knobs (0 = the exact
+    historical program)."""
     ops = (
         scaled_single_device_ops(problem, a, b, aux)
         if scaled
@@ -238,6 +294,7 @@ def _solve_batched(problem: Problem, scaled: bool, a, b, rhs_stack,
         delta=problem.delta, max_iter=problem.iteration_cap,
         weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2,
+        verify_every=verify_every, verify_tol=verify_tol,
     )
     w = s.w * aux if scaled else s.w   # aux broadcasts over the batch axis
     return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr,
@@ -276,7 +333,9 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
                   buckets: Sequence[int] = DEFAULT_BUCKETS,
                   bucket: Optional[int] = None,
                   member_ids: Optional[Sequence] = None,
-                  geometries: Optional[Sequence] = None) -> PCGResult:
+                  geometries: Optional[Sequence] = None,
+                  verify_every: int = 0,
+                  verify_tol=None) -> PCGResult:
     """Solve a batch of Poisson problems in one fused device program.
 
     Input forms (exactly one):
@@ -326,6 +385,15 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     ``pcg_solve(problem, geometry=g_i, rhs_gate=…)`` bit-for-bit.
     Padding members reuse member 0's canvases with a zero RHS (they
     stop degenerately at iteration 1 as before).
+
+    ``verify_every`` > 0 arms the PER-MEMBER in-loop integrity probe
+    (``poisson_tpu.integrity``; ``verify_tol`` defaults dtype-aware):
+    a silently corrupted member stops alone with FLAG_INTEGRITY while
+    its batchmates solve on untouched — the masking that already
+    isolates per-member convergence isolates per-member corruption
+    verdicts too. The stride is part of the executable identity, so
+    verified buckets form their own bucket-cache key family and
+    ``verify_every=0`` keeps the historical executables byte-for-byte.
     """
     if mesh is not None:
         raise ValueError(
@@ -480,6 +548,16 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     # different operand signature, hence a different executable family —
     # but NEVER the fingerprints: every geometry mix of a bucket shares
     # one executable, which is the whole point of co-batching.
+    from poisson_tpu.solvers.pcg import resolve_verify_tol
+
+    verify_every = int(verify_every)
+    v_tol = (resolve_verify_tol(verify_tol, dtype_name)
+             if verify_every > 0 else 0.0)
+    # The verify stride is executable identity (a static jit arg), so
+    # the bucket-cache key mirrors it — but ONLY when verifying: the
+    # flag-off key keeps its historical shape and counter arithmetic.
+    verify_key = (("verify", verify_every, v_tol)
+                  if verify_every > 0 else None)
     if geo is not None:
         def stack_pad(idx):
             stack = jnp.stack([s[idx] for s in setups])
@@ -492,15 +570,20 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
             return stack
 
         key = (size, jit_problem, dtype_name, use_scaled, "geo")
+        if verify_key:
+            key = key + (verify_key,)
         _count_bucket(key, batch, size)
         result = _solve_batched_geo(jit_problem, use_scaled,
+                                    verify_every, v_tol,
                                     stack_pad(0), stack_pad(1),
                                     rhs_stack, stack_pad(3))
     else:
         key = (size, jit_problem, dtype_name, use_scaled)
+        if verify_key:
+            key = key + (verify_key,)
         _count_bucket(key, batch, size)
-        result = _solve_batched(jit_problem, use_scaled, a, b, rhs_stack,
-                                aux)
+        result = _solve_batched(jit_problem, use_scaled, verify_every,
+                                v_tol, a, b, rhs_stack, aux)
     if size == batch:
         return result._replace(origin=origin)
     # Slice padding members off every batched field; max_iterations is

@@ -47,6 +47,7 @@ from poisson_tpu.config import Problem
 from poisson_tpu.solvers.pcg import (
     FLAG_CONVERGED,
     FLAG_DEADLINE,
+    FLAG_INTEGRITY,
     FLAG_NONE,
     FLAG_NONFINITE,
     PCGResult,
@@ -56,6 +57,7 @@ from poisson_tpu.solvers.pcg import (
     make_pcg_body,
     resolve_dtype,
     resolve_scaled,
+    resolve_verify_tol,
     scaled_single_device_ops,
     single_device_ops,
 )
@@ -86,11 +88,17 @@ def _fingerprint(problem: Problem, dtype_name: str, scaled: bool) -> str:
     return repr((sorted(fields.items()), dtype_name, scaled))
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
 def _run_chunk(problem: Problem, scaled: bool, chunk: int,
                stagnation_window: int, stream_every: int,
-               a, b, aux, state: PCGState) -> PCGState:
-    """Advance the solve by at most ``chunk`` iterations (device-resident)."""
+               verify_every: int, verify_tol: float,
+               a, b, aux, rhs, state: PCGState) -> PCGState:
+    """Advance the solve by at most ``chunk`` iterations
+    (device-resident). ``verify_every``/``verify_tol`` are the static
+    integrity-probe knobs (``poisson_tpu.integrity``); ``rhs`` is the
+    probe's true-residual reference — callers pass None when the probe
+    is off, so flag-off programs keep their historical operand
+    signature (and HLO) exactly."""
     ops = (
         scaled_single_device_ops(problem, a, b, aux)
         if scaled
@@ -100,6 +108,8 @@ def _run_chunk(problem: Problem, scaled: bool, chunk: int,
         ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2,
         stagnation_window=stagnation_window, stream_every=stream_every,
+        verify_every=verify_every, verify_tol=verify_tol,
+        verify_rhs=rhs,
     )
     stop_at = jnp.minimum(state.k + chunk, problem.iteration_cap)
 
@@ -192,10 +202,12 @@ def run_chunked(state, *, advance, to_portable, path: Optional[str],
             if watchdog is not None:
                 watchdog.beat(k=int(state.k), diff=float(state.diff))
             flag = _state_flag(state)
-            if flag == FLAG_NONFINITE:
+            if flag in (FLAG_NONFINITE, FLAG_INTEGRITY):
                 # Poisoned state: saving it would overwrite the last good
-                # generation with NaNs. ``flag`` is mesh-replicated, so
-                # every process skips in step.
+                # generation with NaNs — or, for an integrity verdict
+                # (poisson_tpu.integrity), with silently corrupted
+                # buffers the CRC would then happily seal. ``flag`` is
+                # mesh-replicated, so every process skips in step.
                 break
             if _converged(state) and not keep_checkpoint:
                 # The chunk just converged and the file would be deleted
@@ -447,7 +459,9 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                            stream_every: int = 0,
                            watchdog=None,
                            on_chunk=None,
-                           deadline=None) -> PCGResult:
+                           deadline=None,
+                           verify_every: int = 0,
+                           verify_tol=None) -> PCGResult:
     """Solve with periodic state persistence and automatic resume.
 
     Every ``chunk`` iterations the CG state is written to
@@ -461,7 +475,10 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     chunk-boundary resilience hooks documented on :func:`run_chunked`; a
     deadline expiry returns the partial iterate with
     ``flag == FLAG_DEADLINE`` (the checkpoint survives for a resume with
-    a fresh budget).
+    a fresh budget). ``verify_every``/``verify_tol`` arm the in-loop
+    integrity probe (``poisson_tpu.integrity``); a FLAG_INTEGRITY stop
+    is never persisted — the last good generation survives for the
+    verified-restart driver (``solvers.resilient``).
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -470,6 +487,9 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
     fp = _fingerprint(problem, dtype_name, use_scaled)
 
+    verify_every = int(verify_every)
+    v_tol = (resolve_verify_tol(verify_tol, dtype_name)
+             if verify_every > 0 else 0.0)
     ops = (
         scaled_single_device_ops(problem, a, b, aux)
         if use_scaled
@@ -483,7 +503,8 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
         state,
         advance=lambda s: _run_chunk(problem, use_scaled, chunk,
                                      stagnation_window, int(stream_every),
-                                     a, b, aux, s),
+                                     verify_every, v_tol, a, b, aux,
+                                     rhs if verify_every else None, s),
         to_portable=lambda s: s,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint, keep_last=keep_last,
@@ -501,7 +522,8 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
                       scaled=None, rhs_gate=None,
                       stagnation_window: int = 0, stream_every: int = 0,
                       watchdog=None, on_chunk=None,
-                      deadline=None, geometry=None) -> PCGResult:
+                      deadline=None, geometry=None,
+                      verify_every: int = 0, verify_tol=None) -> PCGResult:
     """Chunked single-device solve WITHOUT persistence: the same
     chunk-boundary loop as :func:`pcg_solve_checkpointed` (watchdog beats,
     fault hooks, deadline awareness) minus the disk. This is the dispatch
@@ -517,6 +539,9 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
     the chunked program is unchanged — the service's deadline-carrying
     geometry requests dispatch through here). A deadline expiry returns
     the partial iterate with ``flag == FLAG_DEADLINE``.
+    ``verify_every``/``verify_tol`` arm the in-loop integrity probe
+    (``poisson_tpu.integrity``) — the solve service's defensive
+    verification rides this path for chunked dispatches.
     """
     from poisson_tpu.solvers.pcg import solve_setup
 
@@ -528,6 +553,9 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
                                  geometry=geometry)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
+    verify_every = int(verify_every)
+    v_tol = (resolve_verify_tol(verify_tol, dtype_name)
+             if verify_every > 0 else 0.0)
     ops = (
         scaled_single_device_ops(problem, a, b, aux)
         if use_scaled
@@ -537,7 +565,8 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
         init_state(ops, rhs),
         advance=lambda s: _run_chunk(problem, use_scaled, chunk,
                                      stagnation_window, int(stream_every),
-                                     a, b, aux, s),
+                                     verify_every, v_tol, a, b, aux,
+                                     rhs if verify_every else None, s),
         to_portable=lambda s: s,
         path=None, fingerprint="", cap=problem.iteration_cap,
         keep_checkpoint=False,

@@ -152,6 +152,50 @@ def _step_lanes(problem: Problem, scaled: bool, chunk: int,
     return lax.while_loop(cond, masked_body, state)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _step_lanes_verify(problem: Problem, scaled: bool, chunk: int,
+                       verify_every: int, verify_tol: float,
+                       a, b, aux, rhs_stack, state: PCGState) -> PCGState:
+    """:func:`_step_lanes` with the PER-LANE integrity probe armed
+    (``poisson_tpu.integrity``): the pair-form body
+    (``make_pcg_member_body``) is vmapped with ``rhs_stack`` so each
+    lane's drift invariant checks its OWN right-hand side — a flipped
+    bit stops only the corrupted lane with FLAG_INTEGRITY; its
+    co-residents' trajectories are untouched (masked like every other
+    per-lane stop). A separate jitted program on purpose: the flag-off
+    :func:`_step_lanes` keeps its historical operand signature and HLO
+    byte-for-byte."""
+    from poisson_tpu.solvers.pcg import make_pcg_member_body
+
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    member = make_pcg_member_body(
+        ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+        verify_every=verify_every, verify_tol=verify_tol,
+    )
+    vbody = jax.vmap(member, in_axes=(0, 0))
+    stop_at = jnp.minimum(state.k + chunk, problem.iteration_cap)
+
+    def masked_body(s: PCGState) -> PCGState:
+        stepped = vbody(s, rhs_stack)
+        frozen = s.done | (s.k >= stop_at)
+
+        def keep(old, new):
+            pred = frozen.reshape(frozen.shape + (1,) * (new.ndim - 1))
+            return jnp.where(pred, old, new)
+
+        return jax.tree_util.tree_map(keep, s, stepped)
+
+    def cond(s: PCGState):
+        return jnp.any((~s.done) & (s.k < stop_at))
+
+    return lax.while_loop(cond, masked_body, state)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
 def _step_lanes_geo(problem: Problem, scaled: bool, chunk: int,
                     a_stack, b_stack, aux_stack,
@@ -171,6 +215,27 @@ def _step_lanes_geo(problem: Problem, scaled: bool, chunk: int,
         problem, scaled, a_stack, b_stack, aux_stack, state, stop_at,
         delta=problem.delta, weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4))
+def _step_lanes_geo_verify(problem: Problem, scaled: bool, chunk: int,
+                           verify_every: int, verify_tol: float,
+                           a_stack, b_stack, aux_stack, rhs_stack,
+                           state: PCGState) -> PCGState:
+    """:func:`_step_lanes_geo` with the per-lane integrity probe armed:
+    canvases AND right-hand sides ride per-lane stacks, so each lane's
+    drift invariant checks its own domain's true residual. Separate
+    program for the same reason as :func:`_step_lanes_verify` — the
+    flag-off geo stepping executable stays byte-identical."""
+    from poisson_tpu.solvers.batched import pcg_step_batched_fields
+
+    stop_at = jnp.minimum(state.k + chunk, problem.iteration_cap)
+    return pcg_step_batched_fields(
+        problem, scaled, a_stack, b_stack, aux_stack, state, stop_at,
+        delta=problem.delta, weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+        verify_every=verify_every, verify_tol=verify_tol,
+        rhs_stack=rhs_stack)
 
 
 class LaneResult(NamedTuple):
@@ -206,7 +271,8 @@ class LaneBatch:
 
     def __init__(self, problem: Problem, bucket: int, *, dtype=None,
                  scaled=None, chunk: int = 50, on_boundary=None,
-                 multi_geometry: bool = False):
+                 multi_geometry: bool = False, verify_every: int = 0,
+                 verify_tol=None):
         if bucket < 1:
             raise ValueError(f"bucket must be >= 1, got {bucket}")
         if chunk < 1:
@@ -260,6 +326,20 @@ class LaneBatch:
             self._a_stack = jnp.broadcast_to(a, wide) + 0
             self._b_stack = jnp.broadcast_to(b, wide) + 0
             self._aux_stack = jnp.broadcast_to(aux, wide) + 0
+        # In-loop integrity probe (poisson_tpu.integrity), per lane:
+        # each lane's drift invariant needs that lane's OWN right-hand
+        # side, so a verified table carries a per-lane RHS stack spliced
+        # alongside the state. verify_every=0 (the default) allocates
+        # nothing and steps through the exact historical executables.
+        self.verify_every = int(verify_every)
+        if self.verify_every > 0:
+            from poisson_tpu.solvers.pcg import resolve_verify_tol
+
+            self.verify_tol = resolve_verify_tol(verify_tol,
+                                                 self.dtype_name)
+            self._rhs_stack = zeros      # EMPTY lanes: zero RHS
+        else:
+            self.verify_tol = 0.0
         self.origin: List[object] = [None] * self.bucket
         self.steps = 0                # chunk steps executed
         self.idle_lane_steps = 0      # Σ over steps of non-ACTIVE lanes
@@ -321,6 +401,9 @@ class LaneBatch:
                               ga, gb, gaux, rhs)
         lane_idx = jnp.asarray(lane, jnp.int32)
         self.state = _set_lane(self.state, lane_idx, member)
+        if self.verify_every > 0:
+            self._rhs_stack = _set_field_lane(self._rhs_stack, lane_idx,
+                                              rhs)
         if self.multi_geometry:
             self._a_stack = _set_field_lane(self._a_stack, lane_idx, ga)
             self._b_stack = _set_field_lane(self._b_stack, lane_idx, gb)
@@ -340,7 +423,19 @@ class LaneBatch:
         active = len(self.active_lanes())
         idle = self.bucket - active
         if active:
-            if self.multi_geometry:
+            if self.verify_every > 0 and self.multi_geometry:
+                self.state = _step_lanes_geo_verify(
+                    self._jit_problem, self.use_scaled, self.chunk,
+                    self.verify_every, self.verify_tol,
+                    self._a_stack, self._b_stack, self._aux_stack,
+                    self._rhs_stack, self.state)
+            elif self.verify_every > 0:
+                self.state = _step_lanes_verify(
+                    self._jit_problem, self.use_scaled, self.chunk,
+                    self.verify_every, self.verify_tol,
+                    self._a, self._b, self._aux, self._rhs_stack,
+                    self.state)
+            elif self.multi_geometry:
                 self.state = _step_lanes_geo(
                     self._jit_problem, self.use_scaled, self.chunk,
                     self._a_stack, self._b_stack, self._aux_stack,

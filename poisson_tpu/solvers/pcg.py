@@ -31,7 +31,7 @@ Iteration structure (exactly the reference's, ``stage2:…cpp:400-457``):
 from __future__ import annotations
 
 import functools
-from typing import Callable, NamedTuple
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +66,15 @@ FLAG_STAGNATED = 4   # no best-‖Δw‖ improvement for a full stagnation windo
 # (poisson_tpu.serve). The persisted PCGState never carries it, so a
 # deadline-stopped solve resumes cleanly with a larger budget.
 FLAG_DEADLINE = 5    # deadline expired mid-solve; w is the partial iterate
+# In-loop integrity verdict (poisson_tpu.integrity): the verification
+# probe (verify_every > 0) found the recurrence residual drifting from
+# the true residual, or a convergence event that jumped implausibly —
+# the silent-data-corruption fingerprint (a flipped bit in w/r/p, or a
+# corrupted stencil application). The iterate is SUSPECT, not NaN: the
+# recovery driver restarts from the last *verified-good* snapshot
+# instead of escalating precision, and the solve service types it as an
+# ``integrity`` error class with suspect-cohort taint.
+FLAG_INTEGRITY = 6   # verification probe detected silent corruption
 
 FLAG_NAMES = {
     FLAG_NONE: "running",
@@ -74,6 +83,7 @@ FLAG_NAMES = {
     FLAG_NONFINITE: "nonfinite",
     FLAG_STAGNATED: "stagnated",
     FLAG_DEADLINE: "deadline",
+    FLAG_INTEGRITY: "integrity",
 }
 
 
@@ -187,30 +197,49 @@ def restart_state(ops: PCGOps, rhs, w) -> PCGState:
     return init_state(ops, rhs)._replace(w=w, r=r, z=z, p=z, zr=zr)
 
 
-def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
-                  h1: float, h2: float, stagnation_window: int = 0,
-                  stream_every: int = 0):
-    """One PCG iteration as a pure state→state function — shared by the
-    convergence ``while_loop`` (:func:`pcg_loop`) and the fixed-budget
-    diagnostic ``scan`` (``solvers.history``).
+def make_pcg_member_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
+                         h1: float, h2: float, stagnation_window: int = 0,
+                         stream_every: int = 0, verify_every: int = 0,
+                         verify_tol: float = 0.0,
+                         verify_jump: Optional[float] = None,
+                         verify_colsum=None):
+    """The PCG iteration as a ``body(state, rhs) -> state`` pair-form —
+    the verification-capable core :func:`make_pcg_body` wraps. The
+    second argument is ONLY read when ``verify_every > 0`` (the in-loop
+    integrity probe needs the RHS to recompute the true residual); the
+    batched/lane drivers vmap this form with ``in_axes=(0, 0)`` so each
+    member's probe checks its OWN right-hand side and only the
+    corrupted member trips FLAG_INTEGRITY.
 
-    Every iteration classifies its own outcome into ``flag`` so a failing
-    solve stops at the iteration that went bad instead of burning the rest
-    of its budget on NaNs: a non-finite residual/update norm sets
-    FLAG_NONFINITE, the degenerate-direction break FLAG_BREAKDOWN, and —
-    when ``stagnation_window`` > 0 — ``stagnation_window`` consecutive
-    iterations without a new best ‖Δw‖ set FLAG_STAGNATED. The checks only
-    ever stop iterations that could no longer converge, so converging
-    solves keep their golden iteration counts bit-for-bit.
+    With ``verify_every == 0`` (the default) no probe is traced and the
+    body is the exact historical iteration — byte-identical HLO, golden
+    iteration counts bit-for-bit (pinned by tests/test_integrity.py).
 
-    ``stream_every`` > 0 additionally ships (k, ‖Δw‖) to the host-side
-    telemetry sink every that many iterations (``obs.stream``) via an
-    unordered ``jax.debug.callback`` — progress visibility out of the
-    fused loop. It is a trace-time constant: at the default 0 no
-    callback exists in the program and the iterations are untouched.
+    When verifying, every ``verify_every``-th iteration AND every
+    convergence event runs the residual-drift invariant
+    (``poisson_tpu.integrity.probe``): ``‖(b − Aw) − r‖`` beyond
+    ``verify_tol`` relative to the residual/RHS scale stamps
+    FLAG_INTEGRITY and stops the member. A convergence whose previous
+    best ‖Δw‖ sat more than ``verify_jump`` (default
+    ``integrity.DEFAULT_VERIFY_JUMP``) above this step's own ‖Δw‖ is
+    classified corrupt too, as is a one-step ‖Δw‖ collapse beyond
+    ``integrity.DEFAULT_VERIFY_COLLAPSE`` without converging — the two
+    faces of a flipped search direction, which keeps the recurrence
+    consistent and is invisible to the drift check. ``verify_colsum``
+    (the precomputed ``A·𝟙``) additionally enables the checksum-row
+    ABFT identity on the stencil application at each probe.
     """
+    if verify_every > 0:
+        from poisson_tpu.integrity.probe import (
+            DEFAULT_VERIFY_COLLAPSE,
+            DEFAULT_VERIFY_JUMP,
+        )
 
-    def body(s: PCGState) -> PCGState:
+        if verify_jump is None:
+            verify_jump = DEFAULT_VERIFY_JUMP
+        verify_collapse = DEFAULT_VERIFY_COLLAPSE
+
+    def body(s: PCGState, vrhs=None) -> PCGState:
         p = ops.exchange(s.p)
         Ap = ops.apply_A(p)
         denom = ops.dot(Ap, p)
@@ -247,18 +276,83 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
             stagnated = (~converged) & (stall_new >= stagnation_window)
         else:
             stagnated = jnp.asarray(False)
-        flag = jnp.where(
-            nonfinite, FLAG_NONFINITE,
-            jnp.where(converged, FLAG_CONVERGED,
-                      jnp.where(stagnated, FLAG_STAGNATED, FLAG_NONE)),
-        ).astype(jnp.int32)
+        if verify_every > 0:
+            # The integrity probe: due every verify_every iterations and
+            # on every convergence event (a corrupted solve must never
+            # hand out a "converged" iterate unverified). lax.cond keeps
+            # the extra stencil application off the non-probe
+            # iterations; the probe only READS — clean solves keep
+            # their golden iteration counts (iterates agree with the
+            # unverified program to round-off: the probe's presence can
+            # shift XLA's fusion choices by an ULP).
+            from poisson_tpu.integrity.probe import (
+                abft_drift_exceeds,
+                drift_exceeds,
+            )
+
+            due = (((s.k + 1) % verify_every) == 0) | converged
+
+            def _probe():
+                bad = drift_exceeds(ops, w_new, r_new, vrhs, verify_tol)
+                if verify_colsum is not None:
+                    bad = bad | abft_drift_exceeds(verify_colsum, p, Ap,
+                                                   verify_tol)
+                return bad
+
+            corrupt = lax.cond(due, _probe,
+                               lambda: jnp.zeros_like(converged))
+            # The false-convergence jump guard: genuine update-norm
+            # convergence is gradual (the best ‖Δw‖ approaches δ before
+            # crossing it, so the final step's ratio is single digits);
+            # a convergence whose previous best sat ``verify_jump``
+            # times above THIS step's ‖Δw‖ is a collapsed α from a
+            # corrupted search direction. Ratio against diff, not δ: a
+            # flip late in the solve collapses from wherever best was,
+            # which an absolute δ-multiple would miss. isfinite(best)
+            # exempts a legitimate first-iteration convergence (best
+            # still ∞).
+            suspicious = (converged & jnp.isfinite(s.best)
+                          & (s.best > verify_jump * diff))
+            # The mid-solve collapse guard: the SAME flipped-direction
+            # physics when the collapsed ‖Δw‖ lands ABOVE δ — no
+            # convergence event, so the jump guard never looks, and the
+            # recurrence stays consistent, so the drift probe is blind
+            # in principle. A one-step drop beyond verify_collapse
+            # (clean CG measures ≤ 1.4×; the flip's gain factor is
+            # ×2¹⁶ and up) is corruption. isfinite(s.diff) exempts the
+            # first iteration after init/restart (diff starts at ∞).
+            collapsed = ((~converged) & jnp.isfinite(s.diff)
+                         & (s.diff > verify_collapse * diff))
+            corrupt = (corrupt | suspicious | collapsed) & ~nonfinite
+            # A corrupt verdict freezes the member; keep the PRE-flip
+            # best so the recovery driver's recheck can reproduce the
+            # jump condition (the collapsed diff would otherwise have
+            # just overwritten its own evidence) and so a false-alarm
+            # resume keeps the honest progress floor.
+            best_new = jnp.where(corrupt, s.best, best_new)
+            flag = jnp.where(
+                nonfinite, FLAG_NONFINITE,
+                jnp.where(corrupt, FLAG_INTEGRITY,
+                          jnp.where(converged, FLAG_CONVERGED,
+                                    jnp.where(stagnated, FLAG_STAGNATED,
+                                              FLAG_NONE))),
+            ).astype(jnp.int32)
+            stop = (degenerate | converged | nonfinite | stagnated
+                    | corrupt)
+        else:
+            flag = jnp.where(
+                nonfinite, FLAG_NONFINITE,
+                jnp.where(converged, FLAG_CONVERGED,
+                          jnp.where(stagnated, FLAG_STAGNATED, FLAG_NONE)),
+            ).astype(jnp.int32)
+            stop = degenerate | converged | nonfinite | stagnated
 
         # Degenerate break happens before any update (stage2:…cpp:410-415):
         # keep the old state entirely. Convergence break keeps this
         # iteration's w/r/z updates (p is then irrelevant).
         candidate = PCGState(
             k=s.k + 1,
-            done=degenerate | converged | nonfinite | stagnated,
+            done=stop,
             w=w_new, r=r_new, z=z_new, p=p_new,
             zr=zr_new, diff=diff,
             flag=flag, best=best_new, stall=stall_new,
@@ -272,13 +366,76 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
     return body
 
 
+def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
+                  h1: float, h2: float, stagnation_window: int = 0,
+                  stream_every: int = 0, verify_every: int = 0,
+                  verify_tol: float = 0.0,
+                  verify_jump: Optional[float] = None,
+                  verify_rhs=None, verify_colsum=None):
+    """One PCG iteration as a pure state→state function — shared by the
+    convergence ``while_loop`` (:func:`pcg_loop`) and the fixed-budget
+    diagnostic ``scan`` (``solvers.history``).
+
+    Every iteration classifies its own outcome into ``flag`` so a failing
+    solve stops at the iteration that went bad instead of burning the rest
+    of its budget on NaNs: a non-finite residual/update norm sets
+    FLAG_NONFINITE, the degenerate-direction break FLAG_BREAKDOWN, and —
+    when ``stagnation_window`` > 0 — ``stagnation_window`` consecutive
+    iterations without a new best ‖Δw‖ set FLAG_STAGNATED. The checks only
+    ever stop iterations that could no longer converge, so converging
+    solves keep their golden iteration counts bit-for-bit.
+
+    ``stream_every`` > 0 additionally ships (k, ‖Δw‖) to the host-side
+    telemetry sink every that many iterations (``obs.stream``) via an
+    unordered ``jax.debug.callback`` — progress visibility out of the
+    fused loop. It is a trace-time constant: at the default 0 no
+    callback exists in the program and the iterations are untouched.
+
+    ``verify_every`` > 0 threads the in-loop integrity probe
+    (``poisson_tpu.integrity``) into the body against ``verify_rhs``
+    (the RHS this state's true residual is checked against — required
+    when verifying); a detected drift stamps FLAG_INTEGRITY. Like
+    ``stream_every`` it is a trace-time constant: at the default 0 the
+    body is the exact historical program, byte-identical HLO. See
+    :func:`make_pcg_member_body` for the semantics (and for the
+    ``body(state, rhs)`` pair form the batched drivers vmap)."""
+    if verify_every > 0 and verify_rhs is None:
+        raise ValueError(
+            "verify_every > 0 needs verify_rhs — the in-loop integrity "
+            "probe recomputes the true residual b - Aw against it"
+        )
+    member = make_pcg_member_body(
+        ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
+        stagnation_window=stagnation_window, stream_every=stream_every,
+        verify_every=verify_every, verify_tol=verify_tol,
+        verify_jump=verify_jump, verify_colsum=verify_colsum,
+    )
+    if verify_every == 0:
+        return member     # vrhs defaults to None and is never read
+    return lambda s: member(s, verify_rhs)
+
+
 def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
              weighted_norm: bool, h1: float, h2: float,
-             stagnation_window: int = 0, stream_every: int = 0) -> PCGState:
-    """Run the PCG while_loop to convergence; backend-agnostic."""
+             stagnation_window: int = 0, stream_every: int = 0,
+             verify_every: int = 0, verify_tol: float = 0.0,
+             verify_abft: bool = False) -> PCGState:
+    """Run the PCG while_loop to convergence; backend-agnostic.
+    ``verify_every``/``verify_tol`` arm the in-loop integrity probe
+    against this solve's own RHS; ``verify_abft`` additionally traces
+    the checksum-row ABFT identity (the column-sum vector is computed
+    once here, outside the loop)."""
+    colsum = None
+    if verify_every > 0 and verify_abft:
+        from poisson_tpu.integrity.probe import abft_colsum
+
+        colsum = abft_colsum(ops, rhs)
     body = make_pcg_body(
         ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
         stagnation_window=stagnation_window, stream_every=stream_every,
+        verify_every=verify_every, verify_tol=verify_tol,
+        verify_rhs=(rhs if verify_every > 0 else None),
+        verify_colsum=colsum,
     )
 
     def cond(s: PCGState):
@@ -402,12 +559,18 @@ def solve_setup(problem: Problem, dtype_name: str, scaled: bool,
     return geometry_setup(problem, geometry, dtype_name, scaled)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
 def _solve(problem: Problem, scaled: bool, stream_every: int,
+           verify_every: int, verify_tol: float, verify_abft: bool,
            a, b, rhs, aux) -> PCGResult:
     """jitted solve; ``aux`` is the zero-ring-embedded D (unscaled) or
     D^{-1/2} (scaled) on the full grid. ``stream_every`` is the static
-    telemetry stride (0 = no callback traced in — see ``obs.stream``)."""
+    telemetry stride (0 = no callback traced in — see ``obs.stream``);
+    ``verify_every``/``verify_tol``/``verify_abft`` are the static
+    integrity-probe knobs (0 = no probe traced in — see
+    ``poisson_tpu.integrity``; both strides are part of the compile
+    cache key, so flag-off programs are the exact historical
+    executables)."""
     ops = (
         scaled_single_device_ops(problem, a, b, aux)
         if scaled
@@ -419,6 +582,8 @@ def _solve(problem: Problem, scaled: bool, stream_every: int,
         weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2,
         stream_every=stream_every,
+        verify_every=verify_every, verify_tol=verify_tol,
+        verify_abft=verify_abft,
     )
     w = s.w * aux if scaled else s.w
     return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr,
@@ -454,9 +619,23 @@ def resolve_scaled(scaled, dtype_name: str) -> bool:
     return bool(scaled)
 
 
+def resolve_verify_tol(verify_tol, dtype_name: str) -> float:
+    """The integrity probe's relative drift tolerance: the caller's
+    explicit value, else the dtype-aware default
+    (``integrity.probe.default_verify_tol`` — sized for zero false
+    alarms on clean golden solves while exponent-class corruption lands
+    orders of magnitude above the line)."""
+    if verify_tol is not None:
+        return float(verify_tol)
+    from poisson_tpu.integrity.probe import default_verify_tol
+
+    return default_verify_tol(dtype_name)
+
+
 def pcg_solve(problem: Problem, dtype=None, scaled=None,
               rhs_gate=None, stream_every: int = 0,
-              geometry=None) -> PCGResult:
+              geometry=None, verify_every: int = 0,
+              verify_tol=None, verify_abft: bool = False) -> PCGResult:
     """Single-device solve (the stage0/stage1 workload, SURVEY §3.1).
 
     The iteration is jit-compiled end to end; setup runs on the host in fp64
@@ -474,6 +653,16 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
     only the coefficient canvases change; fingerprint-cached, see
     ``geom.cache.*``). Omitted, the solve is byte-identical to every
     prior release.
+
+    ``verify_every`` > 0 arms the in-loop integrity probe
+    (``poisson_tpu.integrity``): every that many iterations (and on
+    every convergence event) the loop recomputes the true residual and
+    stops the solve with ``flag == FLAG_INTEGRITY`` when it drifts from
+    the recurrence beyond ``verify_tol`` (default: dtype-aware) —
+    silent-data-corruption detection for one extra stencil application
+    per check. ``verify_abft`` adds the checksum-row ABFT identity on
+    the stencil application. At 0 (the default) no probe is traced:
+    byte-identical program, bit-for-bit golden counts.
     """
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
@@ -481,7 +670,12 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
                                  geometry=geometry)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
-    return _solve(problem, use_scaled, int(stream_every), a, b, rhs, aux)
+    verify_every = int(verify_every)
+    tol = (resolve_verify_tol(verify_tol, dtype_name)
+           if verify_every > 0 else 0.0)
+    return _solve(problem, use_scaled, int(stream_every), verify_every,
+                  tol, bool(verify_abft and verify_every > 0),
+                  a, b, rhs, aux)
 
 
 def iteration_program(problem: Problem, dtype=None, scaled=None):

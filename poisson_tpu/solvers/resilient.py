@@ -47,6 +47,7 @@ from poisson_tpu.solvers.checkpoint import (
 from poisson_tpu.solvers.pcg import (
     FLAG_CONVERGED,
     FLAG_DEADLINE,
+    FLAG_INTEGRITY,
     FLAG_NAMES,
     FLAG_NONE,
     FLAG_NONFINITE,
@@ -57,6 +58,7 @@ from poisson_tpu.solvers.pcg import (
     restart_state,
     resolve_dtype,
     resolve_scaled,
+    resolve_verify_tol,
     scaled_single_device_ops,
     single_device_ops,
 )
@@ -146,10 +148,27 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                         stream_every: int = 0,
                         watchdog=None,
                         on_chunk=None,
-                        deadline=None) -> PCGResult:
+                        deadline=None,
+                        verify_every: int = 0,
+                        verify_tol=None) -> PCGResult:
     """Single-device solve that survives NaN blow-ups, Krylov breakdowns
     and stagnation by restarting from the last good iterate, escalating
     precision when a restart alone does not help.
+
+    ``verify_every`` > 0 additionally arms the silent-data-corruption
+    defense (``poisson_tpu.integrity``): the in-loop drift probe runs
+    inside every chunk, the driver re-verifies each chunk-boundary
+    state (``integrity.checks``) and carries the newest *verified-good*
+    iterate as a device-resident snapshot — distinct from checkpoint
+    files, which a corrupt state is never written to. A FLAG_INTEGRITY
+    stop (``integrity.detections``) restarts from that verified
+    snapshot (``integrity.verified_restarts``) WITHOUT burning a
+    precision escalation: a flipped bit is a hardware event, not a
+    precision problem, and escalating would treble the cost of every
+    later iteration for nothing. A detection the driver's recheck
+    cannot reproduce is a counted ``integrity.false_alarms`` and the
+    solve resumes from the very state that fired it — a misfiring
+    detector costs one recheck, never a restart.
 
     Converging solves run the exact same iterations as ``pcg_solve`` —
     recovery only engages on states that could no longer converge. With
@@ -180,11 +199,22 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
     a, b, rhs, aux, ops = _build(problem, dtype_name, use_scaled)
     state = saved if saved is not None else init_state(ops, rhs)
 
+    verify_every = int(verify_every)
+    v_tol = (resolve_verify_tol(verify_tol, dtype_name)
+             if verify_every > 0 else 0.0)
     cap = problem.iteration_cap
     restarts = 0
     restarts_at_dtype = 0
     history = []            # (iteration, verdict, action)
     last_good = (state.w, int(state.k))   # device-resident (immutable)
+    # The verified-good snapshot (poisson_tpu.integrity): the newest
+    # chunk-boundary iterate whose residual drift passed the recheck.
+    # Distinct from last_good (a finite state may already be silently
+    # corrupt) and from checkpoint files (never written corrupt, but
+    # disk-shaped); the integrity recovery path restarts from HERE.
+    # The entry state is trivially verified: r = b − Aw by
+    # construction at init, CRC-sealed on a resume.
+    last_verified = (state.w, int(state.k))
     fp = _fingerprint(problem, dtype_name, use_scaled)
     chunks_done = 0
 
@@ -218,7 +248,8 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                 break
             state = _run_chunk(problem, use_scaled, chunk,
                                policy.stagnation_window, int(stream_every),
-                               a, b, aux, state)
+                               verify_every, v_tol, a, b, aux,
+                               rhs if verify_every else None, state)
             jax.block_until_ready(state)
             chunks_done += 1
             if watchdog is not None:
@@ -236,6 +267,21 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                 # not the grid) — before trusting it as "last good".
                 if not bool(jnp.isfinite(state.w).all()):
                     flag = FLAG_NONFINITE
+            if flag == FLAG_NONE and verify_every > 0:
+                # Boundary verification: the in-loop probe only fires on
+                # its stride, so a flip in the chunk's tail could slip
+                # into the snapshot unverified. One drift recheck per
+                # boundary (one stencil application) promotes the state
+                # to verified-good — or catches what the stride missed.
+                from poisson_tpu.integrity.probe import recheck_state
+
+                obs.inc("integrity.checks")
+                drifted, _ = recheck_state(ops, state.w, state.r, rhs,
+                                           v_tol)
+                if drifted:
+                    flag = FLAG_INTEGRITY
+                else:
+                    last_verified = (state.w, int(state.k))
             if flag == FLAG_NONE:
                 # Healthy chunk boundary: snapshot, persist, inject.
                 # jax arrays are immutable, so holding the reference is a
@@ -251,6 +297,87 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                         state = replacement
                 if int(state.k) >= cap:
                     break  # budget exhausted, unconverged: like pcg_solve
+                continue
+
+            if flag == FLAG_INTEGRITY:
+                # Silent-data-corruption verdict: recover from the last
+                # VERIFIED iterate, never escalate precision (the bit
+                # flip was hardware, not arithmetic), and classify
+                # detector misfires honestly before burning a restart.
+                from poisson_tpu.integrity import probe as _iprobe
+
+                obs.inc("integrity.detections")
+                drifted, drift_rel = _iprobe.recheck_state(
+                    ops, state.w, state.r, rhs, v_tol)
+                # Update-norm verdicts (convergence jump, mid-solve
+                # collapse) stop with a CONSISTENT recurrence (a
+                # corrupted search direction updates w and r in step),
+                # so the drift recheck saying "clean" does not clear
+                # them — reproduce the anomaly from the stop state
+                # instead: the body froze the PRE-flip best, so a
+                # genuine verdict carries best well above the collapsed
+                # ‖Δw‖, while ANY clean state has best ≤ diff (best is
+                # the running minimum). Half the collapse ratio keeps
+                # the weakest genuine collapse (best may sit under the
+                # pre-flip diff by CG's own ≤2× oscillation) confirmed.
+                # isfinite guards the first probed iteration after an
+                # init/restart, where best is still ∞ (the corrupt
+                # verdict freezes the PRE-step best): a drift misfire
+                # there must still classify as a false alarm, not read
+                # ∞ > anything as confirmation.
+                import math as _math
+
+                jump_stop = (_math.isfinite(float(state.best))
+                             and float(state.best)
+                             > _iprobe.DEFAULT_VERIFY_COLLAPSE / 2
+                             * float(state.diff))
+                if not drifted and not jump_stop:
+                    obs.inc("integrity.false_alarms")
+                    obs.event("integrity.false_alarm",
+                              iteration=int(state.k), drift=drift_rel)
+                    warnings.warn(
+                        f"integrity probe fired at iteration "
+                        f"{int(state.k)} but the recheck measures drift "
+                        f"{drift_rel:.2e} under tolerance {v_tol:.2e}; "
+                        f"resuming without a restart",
+                        RuntimeWarning, stacklevel=2,
+                    )
+                    state = state._replace(
+                        done=jnp.asarray(False),
+                        flag=jnp.asarray(FLAG_NONE, jnp.int32),
+                    )
+                    continue
+                restarts += 1
+                if restarts > policy.max_restarts:
+                    raise DivergenceError(
+                        f"solve kept failing integrity verification "
+                        f"(detection at iteration "
+                        f"{iterations_scalar(state.k)}, dtype "
+                        f"{dtype_name}) and the recovery budget "
+                        f"({policy.max_restarts} restarts) is exhausted "
+                        f"— the device is likely producing silent data "
+                        f"corruption",
+                        diagnostics=diagnostics(flag),
+                    )
+                w_src, k_src = last_verified
+                history.append((int(state.k), "integrity",
+                                f"verified-restart@{k_src}"))
+                obs.inc("resilient.restarts")
+                obs.inc("integrity.verified_restarts")
+                obs.event("integrity.verified_restart",
+                          iteration=int(state.k), from_iteration=k_src,
+                          drift=drift_rel, restart=restarts)
+                warnings.warn(
+                    f"integrity check failed at iteration "
+                    f"{int(state.k)} (relative drift {drift_rel:.2e}); "
+                    f"restarting from the last verified iterate "
+                    f"(iteration {k_src})",
+                    RuntimeWarning, stacklevel=2,
+                )
+                w_good = jnp.asarray(w_src, jnp.dtype(dtype_name))
+                state = restart_state(ops, rhs, w_good)._replace(
+                    k=jnp.asarray(k_src, jnp.int32)
+                )
                 continue
 
             # flag is a failure verdict: recover or give up.
@@ -275,6 +402,9 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                         problem, dtype_name, use_scaled
                     )
                     fp = _fingerprint(problem, dtype_name, use_scaled)
+                    if verify_every > 0:
+                        # The drift floor moved with the precision.
+                        v_tol = resolve_verify_tol(verify_tol, dtype_name)
                     restarts_at_dtype = 0
                     escalated = True
             action = (f"escalate->{dtype_name}" if escalated

@@ -77,6 +77,16 @@ crash-restart-subprocess    ``python -m poisson_tpu serve`` killed
 dedup-idempotent-submit     duplicate client submits (pending and
                             terminated) dedup against the ledger — the
                             original outcome returns, nothing re-admits
+sdc-verified-restart        a silent bit flip mid-solve is detected by
+                            the in-loop drift probe, typed ``integrity``
+                            with suspect-cohort taint, and recovered by
+                            a verified restart — no precision burned
+sdc-batch-member-isolated   a flipped bit in ONE member of a running
+                            mixed-geometry bucket trips only that
+                            member; its batchmates converge untouched
+sdc-refill-splice           SDC lands on a member freshly spliced into
+                            a RUNNING bucket: detected and retried
+                            without perturbing the in-flight member
 ==========================  ============================================
 
 Every scenario resets the metrics registry, runs against a
@@ -121,11 +131,13 @@ class VirtualClock:
 
 
 _SCENARIOS: dict = {}
+_GROUPS: dict = {}      # scenario name → subsystem group (for --list)
 
 
-def scenario(name: str):
+def scenario(name: str, group: str = "service"):
     def register(fn):
         _SCENARIOS[name] = fn
+        _GROUPS[name] = group
         return fn
 
     return register
@@ -133,6 +145,16 @@ def scenario(name: str):
 
 def scenario_names() -> list:
     return list(_SCENARIOS)
+
+
+def scenario_groups() -> dict:
+    """The campaign catalogue grouped by subsystem, registration order
+    preserved within each group — what ``chaos --list`` renders (a flat
+    24-name list stopped being readable around PR 8)."""
+    groups: dict = {}
+    for name, group in _GROUPS.items():
+        groups.setdefault(group, []).append(name)
+    return groups
 
 
 def _problem():
@@ -447,7 +469,7 @@ def _queue_burst_degradation(seed: int) -> dict:
     }, {"partials": len(partials), "converged": len(converged)})
 
 
-@scenario("divergence-escalate")
+@scenario("divergence-escalate", group="solver-recovery")
 def _divergence_escalate(seed: int) -> dict:
     from poisson_tpu.serve import (
         RetryPolicy,
@@ -484,7 +506,7 @@ def _divergence_escalate(seed: int) -> dict:
         "iterations": out.iterations})
 
 
-@scenario("preempt-typed-error")
+@scenario("preempt-typed-error", group="solver-recovery")
 def _preempt_typed_error(seed: int) -> dict:
     from poisson_tpu.serve import (
         OUTCOME_ERROR,
@@ -511,7 +533,7 @@ def _preempt_typed_error(seed: int) -> dict:
     }, {"message": out.message[:120]})
 
 
-@scenario("corrupt-checkpoint-resume")
+@scenario("corrupt-checkpoint-resume", group="solver-recovery")
 def _corrupt_checkpoint_resume(seed: int) -> dict:
     from poisson_tpu.solvers.checkpoint import (
         pcg_solve_checkpointed,
@@ -557,7 +579,7 @@ def _corrupt_checkpoint_resume(seed: int) -> dict:
     }, {"iterations": int(resumed.iterations)})
 
 
-@scenario("stall-watchdog")
+@scenario("stall-watchdog", group="solver-recovery")
 def _stall_watchdog(seed: int) -> dict:
     from poisson_tpu.parallel.watchdog import Watchdog
     from poisson_tpu.serve import Deadline
@@ -604,7 +626,7 @@ def _continuous_policy(**kw):
     return ServicePolicy(scheduling=SCHED_CONTINUOUS, **kw)
 
 
-@scenario("refill-poison-splice")
+@scenario("refill-poison-splice", group="refill")
 def _refill_poison_splice(seed: int) -> dict:
     from poisson_tpu.serve import (
         OUTCOME_ERROR,
@@ -653,7 +675,7 @@ def _refill_poison_splice(seed: int) -> dict:
         "splices": _counter("serve.refill.splices")})
 
 
-@scenario("refill-deadline-mid-splice")
+@scenario("refill-deadline-mid-splice", group="refill")
 def _refill_deadline_mid_splice(seed: int) -> dict:
     from poisson_tpu.serve import (
         OUTCOME_RESULT,
@@ -693,7 +715,7 @@ def _refill_deadline_mid_splice(seed: int) -> dict:
         "fits_iterations": outs["fits"].iterations})
 
 
-@scenario("refill-taint-across-splice")
+@scenario("refill-taint-across-splice", group="refill")
 def _refill_taint_across_splice(seed: int) -> dict:
     from poisson_tpu.serve import (
         OUTCOME_ERROR,
@@ -742,7 +764,7 @@ def _refill_taint_across_splice(seed: int) -> dict:
         "violations": [sorted(map(str, v)) for v in violations]})
 
 
-@scenario("refill-preempt-occupied")
+@scenario("refill-preempt-occupied", group="refill")
 def _refill_preempt_occupied(seed: int) -> dict:
     from poisson_tpu.serve import (
         BreakerPolicy,
@@ -803,7 +825,7 @@ def _refill_preempt_occupied(seed: int) -> dict:
 # from the emitted serve.* snapshot(s).
 
 
-@scenario("fleet-worker-kill-mid-dispatch")
+@scenario("fleet-worker-kill-mid-dispatch", group="fleet")
 def _fleet_worker_kill_mid_dispatch(seed: int) -> dict:
     from poisson_tpu.serve import (
         FleetPolicy,
@@ -849,7 +871,7 @@ def _fleet_worker_kill_mid_dispatch(seed: int) -> dict:
         "workers": {str(k): v for k, v in workers.items()}})
 
 
-@scenario("fleet-worker-hang-watchdog")
+@scenario("fleet-worker-hang-watchdog", group="fleet")
 def _fleet_worker_hang_watchdog(seed: int) -> dict:
     from poisson_tpu.serve import (
         FleetPolicy,
@@ -893,7 +915,7 @@ def _fleet_worker_hang_watchdog(seed: int) -> dict:
     }, {"p99": svc.stats()["latency_seconds"]["p99"]})
 
 
-@scenario("journal-crash-replay")
+@scenario("journal-crash-replay", group="journal")
 def _journal_crash_replay(seed: int) -> dict:
     from poisson_tpu.serve import (
         SolveJournal,
@@ -954,7 +976,7 @@ def _journal_crash_replay(seed: int) -> dict:
         "recovered_attempts": sorted(o.attempts for o in outs.values())})
 
 
-@scenario("journal-torn-tail")
+@scenario("journal-torn-tail", group="journal")
 def _journal_torn_tail(seed: int) -> dict:
     from poisson_tpu.serve import (
         SolveJournal,
@@ -1014,7 +1036,7 @@ def _journal_torn_tail(seed: int) -> dict:
     }, {"torn_detail": replay.torn_detail})
 
 
-@scenario("crash-restart-subprocess")
+@scenario("crash-restart-subprocess", group="journal")
 def _crash_restart_subprocess(seed: int) -> dict:
     """The acceptance-criteria drill: kill ``python -m poisson_tpu
     serve`` mid-run (exit 75 after two outcomes, telemetry flushed,
@@ -1112,7 +1134,7 @@ def _dedup_idempotent_submit(seed: int) -> dict:
     }, {"outcome_kind": out.kind})
 
 
-@scenario("geometry-mixed-cobatch")
+@scenario("geometry-mixed-cobatch", group="geometry")
 def _geometry_mixed_cobatch(seed: int) -> dict:
     """A mixed-geometry bucket under a poison-member fault: taint and
     requeue key on (request, fingerprint) — the poisoned request never
@@ -1193,6 +1215,185 @@ def _geometry_mixed_cobatch(seed: int) -> dict:
             _counter("serve.requeued.geometry_isolated") >= 1,
     }, {"dispatches": [sorted(map(str, d)) for d in dispatches],
         "poison_attempts": outs["poison"].attempts})
+
+
+# -- silent-data-corruption scenarios (poisson_tpu.integrity) -----------
+# A flipped bit is the fault every OTHER scenario cannot see: no NaN, no
+# crash, no hang — the recurrence residual keeps shrinking while the
+# iterate silently goes wrong. These three drill the detector (the
+# in-loop drift probe), the recovery (verified restart, typed integrity
+# retry, suspect-cohort taint) and the isolation (one corrupted member
+# of a running bucket, innocents untouched) end to end; the invariant is
+# still admitted − (completed + errors + shed) == 0, from the snapshot.
+
+
+@scenario("sdc-verified-restart", group="integrity")
+def _sdc_verified_restart(seed: int) -> dict:
+    """A seeded exponent bit flip mid-chunked-solve with always-on
+    verification: the in-loop probe stamps FLAG_INTEGRITY, the service
+    types it ``integrity``, taints the hardware cohort, and the retry
+    escalates through the resilient driver — which re-hits the SAME
+    flip (per-solve hook) and recovers via verified restart WITHOUT
+    burning a precision escalation."""
+    from poisson_tpu.serve import (
+        IntegrityPolicy,
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import bitflip_per_solve_hook
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+            degradation=_quiet_degradation(),
+            integrity=IntegrityPolicy(verify_every=5),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    p = _problem()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        svc.submit(SolveRequest(
+            request_id="sdc", problem=p, chunk=5,
+            on_chunk=bitflip_per_solve_hook(20, buffer="w", seed=seed),
+        ))
+        (out,) = svc.drain()
+    return _finish("sdc-verified-restart", seed, {
+        "detected_and_typed": _counter("serve.integrity.detections") >= 1
+        and _counter("serve.integrity.retries") >= 1,
+        "hardware_cohort_tainted":
+            _counter("serve.integrity.suspect_cohorts") == 1,
+        "verified_restart_recovered": out.converged and out.restarts >= 1
+        and _counter("integrity.verified_restarts") >= 1,
+        "no_precision_escalation_burned":
+            _counter("resilient.escalations") == 0,
+        "no_false_alarms": _counter("integrity.false_alarms") == 0,
+    }, {"attempts": out.attempts, "restarts": out.restarts,
+        "iterations": out.iterations})
+
+
+@scenario("sdc-batch-member-isolated", group="integrity")
+def _sdc_batch_member_isolated(seed: int) -> dict:
+    """One member of a RUNNING mixed-geometry bucket takes a bit flip
+    mid-flight: the per-member probe stops the corrupted member alone
+    (FLAG_INTEGRITY, masked), its batchmates — different fictitious
+    domains sharing the same lane executable — converge untouched on
+    their first attempt, and the victim converges on its defended
+    retry."""
+    from poisson_tpu.geometry import Ellipse, Rectangle
+    from poisson_tpu.serve import (
+        IntegrityPolicy,
+        RetryPolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import bitflip_lane
+
+    vc = VirtualClock()
+    svc = SolveService(
+        _continuous_policy(
+            capacity=16, max_batch=4, refill_chunk=10,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+            integrity=IntegrityPolicy(verify_every=5),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    p = _problem()
+    geo_a = Ellipse(cx=0.1, cy=0.0, rx=0.7, ry=0.4)
+    geo_b = Rectangle(-0.6, -0.3, 0.5, 0.3)
+    svc.submit(SolveRequest(request_id="victim", problem=p,
+                            geometry=geo_a))
+    svc.submit(SolveRequest(request_id="innocent-0", problem=p,
+                            geometry=geo_b, rhs_gate=1.1))
+    svc.submit(SolveRequest(request_id="innocent-1", problem=p,
+                            geometry=geo_a, rhs_gate=1.2))
+    svc.pump()
+    svc.pump()                   # all three lane-resident, ~20 deep
+    table = svc._pool.workers[0].table
+    lane = next(i for i, e in enumerate(table.entries)
+                if e is not None
+                and e.request.request_id == "victim")
+    co_resident = table.occupied() and len(table.occupants()) == 3
+    bitflip_lane(table.batch, lane, buffer="w", seed=seed)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        outs = {o.request_id: o for o in svc.drain()}
+    innocents = [outs["innocent-0"], outs["innocent-1"]]
+    return _finish("sdc-batch-member-isolated", seed, {
+        "flip_landed_mid_flight": co_resident,
+        "only_the_victim_tripped":
+            _counter("serve.integrity.detections") == 1,
+        "victim_recovered_on_retry": outs["victim"].converged
+        and outs["victim"].attempts == 2,
+        "innocents_untouched": all(
+            o.converged and o.attempts == 1 for o in innocents),
+        "mixed_geometries_shared_the_bucket": table.multi_geometry
+        and geo_a.fingerprint != geo_b.fingerprint,
+    }, {"victim_attempts": outs["victim"].attempts,
+        "innocent_attempts": [o.attempts for o in innocents]})
+
+
+@scenario("sdc-refill-splice", group="integrity")
+def _sdc_refill_splice(seed: int) -> dict:
+    """The refill race under SDC: a fresh member splices into a lane of
+    a RUNNING bucket program, takes a bit flip right after its splice,
+    and is detected/retried without perturbing the in-flight member it
+    joined — the splice machinery and the integrity masking compose,
+    and the ledger still closes."""
+    from poisson_tpu.serve import (
+        IntegrityPolicy,
+        RetryPolicy,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import bitflip_lane
+
+    vc = VirtualClock()
+    svc = SolveService(
+        _continuous_policy(
+            capacity=16, max_batch=2, refill_chunk=10,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+            integrity=IntegrityPolicy(verify_every=5),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    p = _problem()
+    svc.submit(SolveRequest(request_id="early", problem=p))
+    svc.pump()
+    svc.pump()                   # "early" ~20 iterations deep
+    svc.submit(SolveRequest(request_id="late", problem=p, rhs_gate=1.1))
+    svc.pump()                   # "late" splices into the running bucket
+    table = svc._pool.workers[0].table
+    views = {table.entries[v["lane"]].request.request_id: v["k"]
+             for v in table.batch.lane_view()
+             if table.entries[v["lane"]] is not None}
+    spliced_mid_flight = ("late" in views and "early" in views
+                         and views["early"] - views["late"] >= 10)
+    lane = next(i for i, e in enumerate(table.entries)
+                if e is not None and e.request.request_id == "late")
+    bitflip_lane(table.batch, lane, buffer="r", seed=seed)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        outs = {o.request_id: o for o in svc.drain()}
+    return _finish("sdc-refill-splice", seed, {
+        "splice_landed_mid_flight": spliced_mid_flight,
+        "spliced_member_detected":
+            _counter("serve.integrity.detections") == 1,
+        "spliced_member_recovered": outs["late"].converged
+        and outs["late"].attempts == 2,
+        "in_flight_member_untouched": outs["early"].converged
+        and outs["early"].attempts == 1,
+        # Two lane splices (the retry is an escalated SOLO dispatch
+        # through the verified-restart driver, not a re-splice).
+        "splices_counted": _counter("serve.refill.splices") >= 2,
+    }, {"lane_depths_at_flip": views,
+        "late_attempts": outs["late"].attempts})
 
 
 # -- campaign runner ----------------------------------------------------

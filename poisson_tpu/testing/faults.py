@@ -71,6 +71,276 @@ def inject_nan(state, buffer: str = "r"):
     return state._replace(**{buffer: jnp.asarray(arr)})
 
 
+_FLOAT_BITS = {
+    # dtype name → (exponent MSB, next exponent bit, mantissa MSB):
+    # the deterministic bit menu for the two corruption classes. An
+    # IEEE754 layout fact, not a tunable.
+    "float32": (30, 29, 22),
+    "float64": (62, 61, 51),
+}
+
+
+def _exponent_gain(values: np.ndarray) -> np.ndarray:
+    """For each value, the largest magnitude a single *silent* exponent
+    bit up-flip can reach (0 where none exists). A flip multiplies the
+    magnitude by 2^(bit value) for each exponent bit currently CLEAR —
+    so the reachable corruption depends on the value's exponent
+    pattern: an element whose high exponent bits are mostly set can
+    only be nudged (×4, ×256 — perturbations CG absorbs), while one
+    with a clear high bit can jump tens of orders of magnitude (the
+    catastrophic class the integrity probe exists for). 'Silent' keeps
+    the same square/reduction margin as :func:`bitflip_element`."""
+    exp_msb, _, mant_msb = _FLOAT_BITS[str(values.dtype)]
+    uint = {"float32": np.uint32, "float64": np.uint64}[str(values.dtype)]
+    n_exp = exp_msb - mant_msb          # exponent field bits usable
+    bits = (np.abs(values).view(uint) >> np.uint64(mant_msb)
+            if uint is np.uint64
+            else np.abs(values).view(uint) >> np.uint32(mant_msb))
+    bits = bits.astype(np.uint64)
+    limit = float(np.sqrt(np.finfo(values.dtype).max / 1e8))
+    best = np.zeros(values.shape, np.float64)
+    mags = np.abs(values).astype(np.float64)
+    for k in range(n_exp):
+        clear = (bits >> np.uint64(k)) & np.uint64(1) == 0
+        with np.errstate(over="ignore"):
+            grown = np.ldexp(mags, 2 ** k)   # mags · 2^(2^k), inf-safe
+        ok = clear & np.isfinite(grown) & (grown <= limit)
+        best = np.where(ok & (grown > best), grown, best)
+    return best
+
+
+def _flip_float_bit(value, bit: int):
+    """XOR one bit of a float's storage (same dtype back)."""
+    arr = np.asarray(value)
+    uint = {"float32": np.uint32, "float64": np.uint64}[str(arr.dtype)]
+    flipped = arr.view(uint) ^ uint(np.uint64(1) << np.uint64(bit))
+    return flipped.view(arr.dtype)
+
+
+def bitflip_element(value, bit_class: str = "exponent",
+                    bit: Optional[int] = None):
+    """Flip one storage bit of a float — the SDC primitive. Returns the
+    corrupted value, guaranteed finite and different from the input
+    (the point of silent corruption is that NOTHING loud happens — a
+    NaN/Inf is caught by the PR 1 divergence detector, which is exactly
+    the defense this fault model slips past).
+
+    ``bit_class='exponent'`` picks the exponent bit whose flip grows
+    the magnitude the MOST while every square/inner product the solver
+    forms with it stays finite — the *silent catastrophic* class. The
+    two same-family flips it deliberately avoids are loud or benign,
+    not silent: flipping past the overflow line turns the next dot
+    product into Inf/NaN (the PR 1 rail fires — defense in depth, not
+    this layer's case), and a magnitude-DECREASING flip of one buffer
+    entry is a perturbation CG itself absorbs. ``bit_class='mantissa'``
+    flips the mantissa MSB (a 1.5×-class perturbation — small, silent,
+    the hardest kind; detection is best-effort). An explicit ``bit``
+    overrides the class entirely (falling back down the exponent field
+    if that exact flip lands non-finite)."""
+    arr = np.asarray(value)
+    name = str(arr.dtype)
+    if name not in _FLOAT_BITS:
+        raise ValueError(f"bitflip supports float32/float64 buffers, "
+                         f"got {name}")
+    exp_msb, exp_lsb, mant_msb = _FLOAT_BITS[name]
+    if bit is not None:
+        # Explicit bit: honor it, falling back down the exponent field
+        # only if the exact flip is non-finite.
+        for b in [int(bit)] + list(range(exp_msb, mant_msb, -1)):
+            flipped = _flip_float_bit(arr, b)
+            if np.isfinite(flipped) and flipped != arr:
+                return flipped
+        raise ValueError(f"no finite bit flip exists for value {arr!r}")
+    if bit_class == "mantissa":
+        flipped = _flip_float_bit(arr, mant_msb)
+        if np.isfinite(flipped) and flipped != arr:
+            return flipped
+        raise ValueError(f"mantissa flip of {arr!r} is not silent")
+    if bit_class != "exponent":
+        raise ValueError(
+            f"bit_class must be exponent/mantissa, got {bit_class!r}")
+    # Squares (norms, dots) are the first thing the solver forms; a
+    # margin of ~1e8 over the square keeps grid-sized reductions finite
+    # too, so the corruption stays invisible to the NaN rail.
+    limit = float(np.sqrt(np.finfo(arr.dtype).max / 1e8))
+    best = None
+    for b in range(mant_msb + 1, exp_msb + 1):
+        flipped = _flip_float_bit(arr, b)
+        if not (np.isfinite(flipped) and flipped != arr):
+            continue
+        mag = abs(float(flipped))
+        if mag <= abs(float(arr)) or mag > limit:
+            continue
+        if best is None or mag > abs(float(best)):
+            best = flipped
+    if best is not None:
+        return best
+    # Value too large for any silent up-flip: take the biggest finite
+    # change available (a down-flip — still a flipped bit, still SDC).
+    for b in range(exp_msb, mant_msb, -1):
+        flipped = _flip_float_bit(arr, b)
+        if np.isfinite(flipped) and flipped != arr:
+            return flipped
+    raise ValueError(f"no finite bit flip exists for value {arr!r}")
+
+
+_BITFLIP_BUFFERS = {
+    # Injectable buffer names → the PCGState field the flip lands in.
+    # "Ap" is the transient stencil-application corruption: Ap itself is
+    # never stored (recomputed every iteration), so its ONLY persistent
+    # trace is the entry it wrote into the residual recurrence
+    # r ← r − αAp — flipping r's landed entry IS the Ap fault model,
+    # and it is exactly what the drift invariant ‖(b − Aw) − r‖ sees.
+    "w": "w",
+    "r": "r",
+    "p": "p",
+    "z": "z",
+    "Ap": "r",
+}
+
+
+def inject_bitflip(state, buffer: str = "w", member: Optional[int] = None,
+                   element: Optional[tuple] = None,
+                   bit_class: str = "exponent",
+                   bit: Optional[int] = None, seed: int = 0):
+    """Return ``state`` with one storage bit flipped in the named
+    buffer — the seeded deterministic silent-data-corruption injector
+    (``poisson_tpu.integrity`` is the detector it drills).
+
+    Unlike :func:`inject_nan`, the corrupted value is finite: the
+    in-loop NaN/divergence classification must NOT fire — only the
+    integrity probe can see this fault. ``member`` selects one member
+    of a batched/lane state (the leading axis), so a running bucket can
+    be corrupted per-member: the batchmates' buffers are untouched.
+    ``element`` pins the (row, col) interior node; by default a seeded
+    RNG picks among the top-half-magnitude interior entries — a flip in
+    a significant entry, the honest model (flipping a near-zero entry
+    is a perturbation, not a corruption, and 'detect what cannot
+    matter' is not a useful contract). ``buffer`` accepts the solver
+    state fields (w/r/p/z) plus ``"Ap"`` — the transient
+    stencil-application fault, which lands in the residual recurrence
+    (see ``_BITFLIP_BUFFERS``)."""
+    import random
+
+    if buffer not in _BITFLIP_BUFFERS:
+        raise ValueError(f"bitflip buffer must be one of "
+                         f"{sorted(_BITFLIP_BUFFERS)}, got {buffer!r}")
+    buffer = _BITFLIP_BUFFERS[buffer]
+    arr = np.array(np.asarray(getattr(state, buffer)))
+    target = arr[member] if member is not None else arr
+    if element is None:
+        interior = np.abs(target[1:-1, 1:-1])
+        finite = np.isfinite(interior) & (interior > 0)
+        if not finite.any():
+            raise ValueError(f"buffer {buffer!r} has no nonzero finite "
+                             "interior entry to corrupt")
+        cutoff = np.median(interior[finite])
+        candidates = finite & (interior >= cutoff)
+        if bit_class == "exponent":
+            # The exponent class models the CATASTROPHIC flip, so the
+            # element is chosen by the DAMAGE a single silent bit can
+            # reach, not by its current magnitude: a normal-range value
+            # has its high exponent bits set (one more flips past
+            # overflow — loud, the NaN rail's case), so the elements a
+            # bit can blow up by orders of magnitude are the SMALL
+            # ones, whose clear high bits are still silently
+            # reachable. Seeded pick among the most-damaging cohort
+            # (≥ half the best reachable post-flip delta).
+            gain = _exponent_gain(target[1:-1, 1:-1])
+            delta = np.where(finite, gain - interior, 0.0)
+            best = float(delta.max())
+            big = finite & (delta >= 0.5 * best)
+            if best > 0 and big.any():
+                candidates = big
+        rows, cols = np.nonzero(candidates)
+        pick = random.Random(seed).randrange(len(rows))
+        element = (int(rows[pick]) + 1, int(cols[pick]) + 1)
+    i, j = element
+    target[i, j] = bitflip_element(target[i, j], bit_class=bit_class,
+                                   bit=bit)
+    return state._replace(**{buffer: jnp.asarray(arr)})
+
+
+def bitflip_hook(at_iteration: int, buffer: str = "w",
+                 bit_class: str = "exponent", bit: Optional[int] = None,
+                 seed: int = 0):
+    """Chunk-boundary SDC injection (fires once per hook instance, like
+    ``chunk_hook``'s NaN): flip one bit of ``buffer`` at the first
+    boundary whose iteration count reaches ``at_iteration``."""
+    fired = {"done": False}
+
+    def hook(state, chunks_done: int):
+        if not fired["done"] and int(state.k) >= at_iteration:
+            fired["done"] = True
+            return inject_bitflip(state, buffer, bit_class=bit_class,
+                                  bit=bit, seed=seed)
+        return None
+
+    return hook
+
+
+def bitflip_per_solve_hook(at_iteration: int, buffer: str = "w",
+                           bit_class: str = "exponent",
+                           bit: Optional[int] = None, seed: int = 0):
+    """Like :func:`bitflip_hook` but re-armed for every new solve run
+    (``chunks_done`` restarting — the ``nan_per_solve_hook`` idiom): the
+    chaos campaign's verified-restart scenario needs the escalated
+    retry to hit the SAME corruption, not ride a spent hook."""
+    state_ = {"armed": True, "last_chunks": 0}
+
+    def hook(state, chunks_done: int):
+        if chunks_done <= state_["last_chunks"]:
+            state_["armed"] = True
+        state_["last_chunks"] = chunks_done
+        if state_["armed"] and int(state.k) >= at_iteration:
+            state_["armed"] = False
+            return inject_bitflip(state, buffer, bit_class=bit_class,
+                                  bit=bit, seed=seed)
+        return None
+
+    return hook
+
+
+def bitflip_lane(batch, lane: int, buffer: str = "w",
+                 bit_class: str = "exponent", bit: Optional[int] = None,
+                 seed: int = 0) -> None:
+    """Flip one storage bit of one LANE of a running
+    :class:`~poisson_tpu.solvers.lanes.LaneBatch` — the lane-engine
+    variant of :func:`inject_bitflip`: the corruption lands in exactly
+    one member of the live bucket state between chunk steps, its
+    co-residents' buffers untouched (the per-member isolation the
+    masked integrity probe must then mirror)."""
+    batch.state = inject_bitflip(batch.state, buffer, member=lane,
+                                 bit_class=bit_class, bit=bit, seed=seed)
+
+
+def parse_bitflip_spec(spec: str):
+    """Parse the CLI's ``--fault-bitflip-at ITER[:buffer[:bit]]`` form
+    to ``(iteration, buffer, bit)`` (bit None = the exponent class)."""
+    parts = str(spec).split(":")
+    if len(parts) > 3:
+        raise ValueError(
+            f"bitflip spec is ITER[:buffer[:bit]], got {spec!r}")
+    try:
+        iteration = int(parts[0])
+    except ValueError:
+        raise ValueError(f"bitflip iteration must be an int, got "
+                         f"{parts[0]!r}")
+    buffer = parts[1] if len(parts) > 1 and parts[1] else "w"
+    if buffer not in _BITFLIP_BUFFERS:
+        raise ValueError(f"bitflip buffer must be one of "
+                         f"{'/'.join(sorted(_BITFLIP_BUFFERS))}, got "
+                         f"{buffer!r}")
+    bit = None
+    if len(parts) > 2 and parts[2]:
+        try:
+            bit = int(parts[2])
+        except ValueError:
+            raise ValueError(f"bitflip bit must be an int, got "
+                             f"{parts[2]!r}")
+    return iteration, buffer, bit
+
+
 def corrupt_file(path: str, mode: str = "flip") -> None:
     """Damage a file on disk the way real storage does.
 

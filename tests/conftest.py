@@ -94,6 +94,15 @@ def pytest_configure(config):
         "gradients; CPU-fast; runs in tier-1, selectable with "
         "-m geom)",
     )
+    config.addinivalue_line(
+        "markers",
+        "integrity: numerical-integrity / silent-data-corruption suite "
+        "(seeded bit-flip campaign across buffers and precisions, "
+        "zero-false-alarm pins on clean goldens, byte-identical-HLO "
+        "pin for verify_every=0, per-member masking, SDC chaos "
+        "scenarios, sentinel cohort pins; CPU-fast; runs in tier-1, "
+        "selectable with -m integrity)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

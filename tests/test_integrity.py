@@ -1,0 +1,547 @@
+"""Numerical integrity: the silent-data-corruption defense (tier-1,
+CPU; -m integrity).
+
+The load-bearing claims, each asserted here:
+
+- **Detection**: every exponent-class bit flip the seeded injector
+  lands in {w, r, p, Ap} — across precisions, injection iterations and
+  seeds — is detected within ``verify_every`` iterations and the solve
+  still converges via a verified restart (never a precision
+  escalation). The one carve-out is physics, not tuning: an EARLY
+  f32 search-direction flip keeps the recurrence consistent and lands
+  inside CG's own step-to-step dynamic range — that regime is pinned
+  by the bounded-harm test instead (correct answer, merely slower).
+- **Zero false alarms**: clean golden solves (f32 + f64, reference and
+  geometry domains) run verified with their golden iteration counts
+  and no integrity verdict.
+- **Off means off**: ``verify_every=0`` lowers to the byte-identical
+  HLO of the pre-integrity program (verbatim-copy pin) and golden
+  iteration counts stay bit-for-bit.
+- **Per-member masking**: a flip in one lane of a running bucket stops
+  only that lane with FLAG_INTEGRITY; co-residents converge untouched.
+- **Chaos invariants**: the three SDC scenarios keep the ledger
+  invariant admitted − (completed + errors + shed) == 0.
+- **Sentinel pins**: ``detail.verify_every`` is experiment identity —
+  a verified run never indicts an unverified baseline.
+"""
+
+from __future__ import annotations
+
+import re
+import types
+import warnings
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.integrity import probe
+from poisson_tpu.obs import metrics
+from poisson_tpu.solvers.pcg import (
+    FLAG_CONVERGED,
+    FLAG_INTEGRITY,
+    host_setup,
+    init_state,
+    make_pcg_body,
+    pcg_solve,
+    resolve_scaled,
+    single_device_ops,
+)
+from poisson_tpu.solvers.resilient import pcg_solve_resilient
+from poisson_tpu.testing import faults
+
+pytestmark = pytest.mark.integrity
+
+PROBLEM = Problem(M=48, N=72)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _f64_ops(problem=PROBLEM):
+    a, b, rhs, aux = host_setup(problem, "float64", False)
+    return single_device_ops(problem, a, b, aux), rhs
+
+
+def _run(ops, rhs, n):
+    body = make_pcg_body(ops, delta=PROBLEM.delta,
+                         weighted_norm=PROBLEM.weighted_norm,
+                         h1=PROBLEM.h1, h2=PROBLEM.h2)
+    s = init_state(ops, rhs)
+    for _ in range(n):
+        s = body(s)
+    return s
+
+
+# -- the injector (testing/faults) --------------------------------------
+
+
+@pytest.mark.parametrize("value", [1.0, -3.7e-5, 2.2e-11, 0.125])
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_bitflip_element_exponent_is_silent(value, dtype):
+    """The exponent-class flip is finite, different from the input, and
+    survives squaring with grid-sized headroom — NOTHING loud happens,
+    which is the whole point of the fault model (a NaN/Inf is the PR 1
+    divergence detector's case, not this layer's)."""
+    v = dtype(value)
+    flipped = faults.bitflip_element(v, bit_class="exponent")
+    assert np.isfinite(flipped) and flipped != v
+    assert np.isfinite(np.asarray(flipped, np.float64) ** 2
+                       * np.float64(1e6))
+
+
+def test_bitflip_element_mantissa_and_explicit_bit():
+    v = np.float32(1.5)
+    m = faults.bitflip_element(v, bit_class="mantissa")
+    assert np.isfinite(m) and m != v
+    # Mantissa-MSB flip of a float is a bounded perturbation, not a jump.
+    assert 0.5 < abs(float(m)) / abs(float(v)) < 2.0
+    e = faults.bitflip_element(v, bit=23)
+    assert np.isfinite(e) and e != v
+    with pytest.raises(ValueError):
+        faults.bitflip_element(np.float16(1.0))
+    with pytest.raises(ValueError):
+        faults.bitflip_element(v, bit_class="nope")
+
+
+def test_parse_bitflip_spec_forms_and_errors():
+    assert faults.parse_bitflip_spec("100") == (100, "w", None)
+    assert faults.parse_bitflip_spec("50:r") == (50, "r", None)
+    assert faults.parse_bitflip_spec("50:Ap:29") == (50, "Ap", 29)
+    for bad in ("x", "10:q", "10:w:z", "1:2:3:4"):
+        with pytest.raises(ValueError):
+            faults.parse_bitflip_spec(bad)
+
+
+def test_inject_bitflip_is_deterministic_and_single_element():
+    ops, rhs = _f64_ops()
+    s = _run(ops, rhs, 20)
+    s1 = faults.inject_bitflip(s, "r", seed=3)
+    s2 = faults.inject_bitflip(s, "r", seed=3)
+    d1 = np.asarray(s1.r) - np.asarray(s.r)
+    assert np.array_equal(np.asarray(s1.r), np.asarray(s2.r))
+    assert np.count_nonzero(d1) == 1
+    assert np.isfinite(np.asarray(s1.r)).all()
+    # Untouched buffers stay untouched.
+    assert np.array_equal(np.asarray(s1.w), np.asarray(s.w))
+    with pytest.raises(ValueError):
+        faults.inject_bitflip(s, "nope")
+
+
+def test_inject_bitflip_member_isolates_batchmates():
+    State = types.SimpleNamespace
+    w = np.outer(np.arange(3.0) + 1.0,
+                 np.ones(36)).reshape(3, 6, 6)
+    state = State(w=w.copy())
+    state._replace = lambda **kw: State(**{**vars(state), **kw})
+    out = faults.inject_bitflip(state, "w", member=1, seed=0)
+    delta = np.asarray(out.w) - w
+    assert np.count_nonzero(delta[1]) == 1
+    assert not delta[0].any() and not delta[2].any()
+
+
+# -- the invariants (integrity/probe) -----------------------------------
+
+
+def test_drift_invariant_clean_vs_flipped():
+    ops, rhs = _f64_ops()
+    s = _run(ops, rhs, 20)
+    tol = probe.default_verify_tol("float64")
+    assert not bool(probe.drift_exceeds(ops, s.w, s.r, rhs, tol))
+    bad = faults.inject_bitflip(s, "r", seed=0)
+    assert bool(probe.drift_exceeds(ops, bad.w, bad.r, rhs, tol))
+    confirmed, drift = probe.recheck_state(ops, bad.w, bad.r, rhs, tol)
+    assert confirmed and drift > tol
+
+
+def test_drift_nonfinite_is_a_verdict_not_a_blind_spot():
+    """An overflowed buffer must read as corruption: NaN/Inf compares
+    would silently return False and the probe would go blind on exactly
+    the largest corruptions."""
+    import jax.numpy as jnp
+
+    ops, rhs = _f64_ops()
+    s = _run(ops, rhs, 10)
+    blown = s._replace(w=jnp.full_like(s.w, jnp.inf))
+    assert bool(probe.drift_exceeds(ops, blown.w, blown.r, rhs, 1e-6))
+    confirmed, _ = probe.recheck_state(ops, blown.w, blown.r, rhs, 1e-6)
+    assert confirmed
+
+
+def test_abft_checksum_row_identity():
+    import jax.numpy as jnp
+
+    ops, rhs = _f64_ops()
+    s = _run(ops, rhs, 15)
+    colsum = probe.abft_colsum(ops, rhs)
+    p = ops.exchange(s.p)
+    Ap = ops.apply_A(p)
+    assert not bool(probe.abft_drift_exceeds(colsum, p, Ap, 1e-9))
+    # A corrupted stencil application breaks the identity immediately.
+    bad = Ap.at[7, 9].add(1e-3 * float(jnp.abs(Ap).max()) + 1e-6)
+    assert bool(probe.abft_drift_exceeds(colsum, p, bad, 1e-9))
+
+
+def test_default_tols_are_dtype_aware():
+    assert probe.default_verify_tol("float64") < probe.default_verify_tol(
+        "float32") < probe.default_verify_tol("bfloat16")
+
+
+# -- the campaign: seeded flips across buffers/iterations/precisions ----
+
+# Injection points per buffer. The p (search direction) rows start at
+# 25 for f32: the collapse a silent flip produces grows as the
+# direction decays under the flip's structural magnitude cap, and
+# before ~iteration 20 a scaled-f32 flip lands inside CG's own
+# step-to-step range (≤2.1× vs clean ≤2.5×) — the bounded-harm regime
+# pinned below, not a detection miss. f64 runs unscaled, where the
+# reachable flip is astronomically larger; every point detects.
+_CAMPAIGN = {
+    "float32": {"w": (10, 40), "r": (10, 40), "p": (25, 40),
+                "Ap": (10, 40)},
+    "float64": {"w": (10, 40), "r": (10, 40), "p": (10, 40),
+                "Ap": (10, 40)},
+}
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_seeded_bitflip_campaign_detects_and_recovers(dtype):
+    """Every injected exponent-class flip is detected within
+    verify_every iterations, recovered by a verified restart (never a
+    precision escalation), and the solve converges — with zero false
+    alarms across the whole campaign."""
+    for buffer, ats in _CAMPAIGN[dtype].items():
+        for at in ats:
+            for seed in (0, 1):
+                metrics.reset()
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    res = pcg_solve_resilient(
+                        PROBLEM, dtype=dtype, chunk=5, verify_every=5,
+                        on_chunk=faults.bitflip_per_solve_hook(
+                            at, buffer=buffer, seed=seed))
+                tag = (dtype, buffer, at, seed)
+                assert metrics.get("integrity.detections") >= 1, tag
+                assert metrics.get("integrity.verified_restarts") >= 1, tag
+                assert metrics.get("integrity.false_alarms") == 0, tag
+                assert metrics.get("resilient.escalations") == 0, tag
+                assert int(res.flag) == FLAG_CONVERGED, tag
+                assert res.restarts >= 1, tag
+
+
+def test_early_f32_direction_flip_is_bounded_harm():
+    """The carve-out, proven harmless: an early scaled-f32 flip in p
+    keeps the recurrence consistent (w and r advance in step with the
+    corrupted direction), so CG provably converges to the correct
+    answer — merely slower. No restart is needed and none fires."""
+    golden = pcg_solve_resilient(PROBLEM, dtype="float32", chunk=5)
+    for seed in (0, 1):
+        metrics.reset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            res = pcg_solve_resilient(
+                PROBLEM, dtype="float32", chunk=5, verify_every=5,
+                on_chunk=faults.bitflip_per_solve_hook(
+                    10, buffer="p", seed=seed))
+        assert int(res.flag) == FLAG_CONVERGED
+        assert metrics.get("integrity.false_alarms") == 0
+        err = np.abs(np.asarray(res.w) - np.asarray(golden.w)).max()
+        scale = np.abs(np.asarray(golden.w)).max()
+        assert err < 1e-3 * scale, (seed, err, scale)
+
+
+def test_mantissa_flip_never_false_alarms_the_recovery():
+    """Mantissa-MSB flips (≤2× perturbations) are best-effort by
+    contract; what IS guaranteed: the solve converges and nothing is
+    ever classified false alarm on a real injection that goes
+    undetected (an undetected flip simply never reaches the driver)."""
+    for buffer in ("w", "r"):
+        metrics.reset()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            res = pcg_solve_resilient(
+                PROBLEM, dtype="float64", chunk=5, verify_every=5,
+                on_chunk=faults.bitflip_per_solve_hook(
+                    20, buffer=buffer, bit_class="mantissa", seed=0))
+        assert int(res.flag) == FLAG_CONVERGED
+        assert metrics.get("integrity.false_alarms") == 0
+
+
+# -- zero false alarms on clean goldens ---------------------------------
+
+
+@pytest.mark.parametrize(
+    "M,N,weighted,expected",
+    [(10, 10, False, {17}), (20, 20, False, {31}),
+     (40, 40, True, {50})],
+)
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_clean_goldens_verified_keep_counts(M, N, weighted, expected,
+                                            dtype):
+    r = pcg_solve(Problem(M=M, N=N, weighted_norm=weighted),
+                  dtype=dtype, verify_every=5)
+    assert int(r.flag) == FLAG_CONVERGED
+    assert int(r.iterations) in expected
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_clean_resilient_verified_zero_verdicts(dtype):
+    base = pcg_solve_resilient(PROBLEM, dtype=dtype, chunk=10)
+    metrics.reset()
+    ver = pcg_solve_resilient(PROBLEM, dtype=dtype, chunk=10,
+                              verify_every=5)
+    assert int(ver.iterations) == int(base.iterations)
+    assert ver.restarts == 0
+    assert metrics.get("integrity.detections") == 0
+    assert metrics.get("integrity.false_alarms") == 0
+    assert metrics.get("integrity.checks") >= 1   # boundary rechecks ran
+
+
+def test_clean_geometry_solves_verified_no_false_alarms():
+    from poisson_tpu.geometry import Ellipse, Rectangle
+
+    for geom in (Ellipse(cx=0.1, cy=0.0, rx=0.7, ry=0.4),
+                 Rectangle(-0.6, -0.3, 0.5, 0.3)):
+        base = pcg_solve(PROBLEM, dtype="float32", geometry=geom)
+        ver = pcg_solve(PROBLEM, dtype="float32", geometry=geom,
+                        verify_every=5)
+        assert int(ver.flag) == FLAG_CONVERGED
+        assert int(ver.iterations) == int(base.iterations)
+
+
+# -- off means off: byte-identical HLO, bit-for-bit counts --------------
+
+
+def test_verify_off_hlo_is_byte_identical_to_pre_integrity_body():
+    """``verify_every=0`` must lower to the EXACT pre-integrity
+    program: the fused loop built from today's body is compared against
+    one built from a verbatim copy of the pre-PR iteration body —
+    compiled HLO equal byte-for-byte (debug metadata aside). This is
+    what makes the layer shippable default-off."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from poisson_tpu.solvers.pcg import (
+        _DENOM_TOL,
+        FLAG_BREAKDOWN,
+        FLAG_NONE,
+        FLAG_NONFINITE,
+        FLAG_STAGNATED,
+        PCGState,
+        _select,
+    )
+
+    p = Problem(M=24, N=24)
+    ops, rhs = _f64_ops(p)
+    delta, h1, h2 = p.delta, p.h1, p.h2
+    weighted_norm = p.weighted_norm
+
+    def historical_body(s):
+        # The pre-integrity make_pcg_body inner body, copied VERBATIM
+        # (stream/stagnation off — their flag-off branches are theirs
+        # to pin).
+        p_ = ops.exchange(s.p)
+        Ap = ops.apply_A(p_)
+        denom = ops.dot(Ap, p_)
+        degenerate = jnp.abs(denom) < _DENOM_TOL
+        alpha = s.zr / jnp.where(degenerate, 1.0, denom)
+
+        dw = alpha * p_
+        w_new = s.w + dw
+        r_new = s.r - alpha * Ap
+        sq = ops.sqnorm(dw)
+        diff = (jnp.sqrt(sq * (h1 * h2)) if weighted_norm
+                else jnp.sqrt(sq))
+
+        z_new = ops.apply_Dinv(r_new)
+        zr_new = ops.dot(z_new, r_new)
+        converged = diff < delta
+
+        beta = zr_new / jnp.where(s.zr == 0.0, 1.0, s.zr)
+        p_new = z_new + beta * p_
+
+        nonfinite = ~(jnp.isfinite(diff) & jnp.isfinite(zr_new))
+        improved = diff < s.best
+        best_new = jnp.minimum(s.best, diff)
+        stall_new = jnp.where(improved, 0, s.stall + 1).astype(jnp.int32)
+        stagnated = jnp.asarray(False)
+        flag = jnp.where(
+            nonfinite, FLAG_NONFINITE,
+            jnp.where(converged, FLAG_CONVERGED,
+                      jnp.where(stagnated, FLAG_STAGNATED, FLAG_NONE)),
+        ).astype(jnp.int32)
+
+        candidate = PCGState(
+            k=s.k + 1,
+            done=degenerate | converged | nonfinite | stagnated,
+            w=w_new, r=r_new, z=z_new, p=p_new,
+            zr=zr_new, diff=diff,
+            flag=flag, best=best_new, stall=stall_new,
+        )
+        kept = s._replace(
+            k=s.k + 1, done=jnp.asarray(True),
+            flag=jnp.asarray(FLAG_BREAKDOWN, jnp.int32),
+        )
+        return _select(degenerate, kept, candidate)
+
+    current_body = make_pcg_body(
+        ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
+        verify_every=0,
+    )
+
+    def hlo(body):
+        def loop(r0):
+            def cond(s):
+                return (~s.done) & (s.k < p.iteration_cap)
+
+            return lax.while_loop(cond, body, init_state(ops, r0))
+
+        txt = jax.jit(loop).lower(rhs).compile().as_text()
+        return re.sub(r", metadata=\{[^}]*\}", "", txt)
+
+    assert hlo(current_body) == hlo(historical_body)
+
+
+# -- per-member masking: one corrupted lane, innocents untouched --------
+
+
+def test_masked_per_member_detection_in_a_running_bucket():
+    from poisson_tpu.solvers.lanes import LaneBatch
+
+    prob = Problem(M=32, N=32)
+    gates = {"victim": 1.0, "inn-0": 1.1, "inn-1": 1.2}
+    solo = {mid: pcg_solve(prob, dtype="float32", rhs_gate=g,
+                           verify_every=5)
+            for mid, g in gates.items()}
+    lb = LaneBatch(prob, bucket=4, dtype="float32", chunk=10,
+                   verify_every=5)
+    lanes = {mid: lb.splice(mid, rhs_gate=g) for mid, g in gates.items()}
+    lb.step()                      # everyone ~10 iterations deep
+    faults.bitflip_lane(lb, lanes["victim"], buffer="w", seed=0)
+    for _ in range(60):
+        if all(v["done"] for v in lb.lane_view()
+               if v["member_id"] is not None):
+            break
+        lb.step()
+    out = {v["member_id"]: v for v in lb.lane_view()
+           if v["member_id"] is not None}
+    assert out["victim"]["flag"] == FLAG_INTEGRITY
+    # Detection within one verify stride of the flip landing.
+    assert out["victim"]["k"] <= 10 + 5
+    for mid in ("inn-0", "inn-1"):
+        assert out[mid]["flag"] == FLAG_CONVERGED, out[mid]
+        assert out[mid]["k"] == int(solo[mid].iterations), mid
+    res = lb.retire(lanes["victim"])
+    assert res.flag == FLAG_INTEGRITY and res.member_id == "victim"
+
+
+def test_batched_verified_clean_matches_unverified():
+    from poisson_tpu.solvers.batched import solve_batched
+
+    prob = Problem(M=32, N=32)
+    base = solve_batched(prob, rhs_gates=[1.0, 1.3, 0.8],
+                         dtype="float32")
+    ver = solve_batched(prob, rhs_gates=[1.0, 1.3, 0.8],
+                        dtype="float32", verify_every=5)
+    assert [int(k) for k in ver.iterations] == [
+        int(k) for k in base.iterations]
+    assert all(int(f) == FLAG_CONVERGED for f in ver.flag)
+
+
+# -- service response: typed outcome, suspect-cohort defense ------------
+
+
+def test_suspect_cohort_defense_arms_after_first_strike():
+    from poisson_tpu.serve import (
+        ERROR_INTEGRITY,
+        IntegrityPolicy,
+        ServicePolicy,
+        SolveService,
+    )
+
+    svc = SolveService(ServicePolicy(integrity=IntegrityPolicy()))
+    assert svc._verify_params() == (0, None)
+    # An integrity-class retry defends itself even before any taint.
+    entry = types.SimpleNamespace(last_failure=ERROR_INTEGRITY)
+    assert svc._verify_params([entry])[0] == 25
+    svc._taint_suspect_hw()
+    assert svc._verify_params()[0] == 25
+    assert metrics.get("serve.integrity.suspect_cohorts") == 1
+    svc._taint_suspect_hw()    # idempotent: cohorts, not detections
+    assert metrics.get("serve.integrity.suspect_cohorts") == 1
+    # Always-on policy wins over the suspect stride.
+    svc2 = SolveService(ServicePolicy(
+        integrity=IntegrityPolicy(verify_every=7, verify_tol=1e-4)))
+    assert svc2._verify_params() == (7, 1e-4)
+
+
+@pytest.mark.parametrize("name", [
+    "sdc-verified-restart",
+    "sdc-batch-member-isolated",
+    "sdc-refill-splice",
+])
+def test_sdc_chaos_scenarios_keep_the_ledger(name):
+    from poisson_tpu.testing import chaos
+
+    rep = chaos.run_scenario(name, seed=0)
+    assert rep["ok"], (name, rep["checks"])
+    assert rep["invariant"]["lost"] == 0
+    assert len(chaos.scenario_names()) >= 24
+
+
+def test_chaos_list_groups_include_integrity():
+    from poisson_tpu.testing import chaos
+
+    groups = chaos.scenario_groups()
+    assert set(groups["integrity"]) == {
+        "sdc-verified-restart", "sdc-batch-member-isolated",
+        "sdc-refill-splice"}
+    flat = [n for names in groups.values() for n in names]
+    assert sorted(flat) == sorted(chaos.scenario_names())
+
+
+# -- sentinel cohort/direction pins -------------------------------------
+
+
+def _regress():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "regress", pathlib.Path(__file__).resolve().parents[1]
+        / "benchmarks" / "regress.py")
+    regress = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(regress)
+    return regress
+
+
+def test_regress_verify_every_splits_cohorts():
+    regress = _regress()
+
+    def rec(verify_every, value):
+        return regress.record_from_result({
+            "metric": "mlups",
+            "value": value,
+            "detail": {"grid": [400, 600], "dtype": "float32",
+                       "backend": "xla", "devices": 1,
+                       "platform": "cpu",
+                       **({"verify_every": verify_every}
+                          if verify_every else {})},
+        }, source="test")
+
+    verified = rec(25, 70.0)
+    clean = rec(None, 100.0)
+    assert regress.cohort_key(verified) != regress.cohort_key(clean)
+    assert regress.cohort_key(rec(25, 72.0)) == regress.cohort_key(
+        verified)
+    # A verified run paying its probe overhead among unverified
+    # baselines must NOT alarm: the cohorts never meet.
+    records = [rec(None, 100.0 + i) for i in range(4)] + [verified]
+    verdict = regress.evaluate(records)
+    assert all(r["classification"] != "regression"
+               for r in verdict["records"]), verdict
