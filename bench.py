@@ -1137,6 +1137,131 @@ def _verify_bench(problem, verify_every: int, devices, platform: str,
     return 0
 
 
+def _preconditioner_bench(problem, preconditioner: str, devices,
+                          platform: str, downgraded: bool = False) -> int:
+    """Preconditioner A/B mode (``--preconditioner {jacobi,mg}``): BOTH
+    arms — the Jacobi baseline and the MG-preconditioned solve — run
+    with the chained-slope methodology in one process and land in ONE
+    record. The headline value is the REQUESTED arm's MLUPS;
+    ``detail.preconditioner`` joins the regression sentinel's cohort
+    key (an MG iteration moves V-cycle bytes by design, so MG MLUPS
+    never judge Jacobi baselines — benchmarks/regress.py), and
+    ``detail.preconditioner_ab`` carries both arms' iterations and
+    wall-clock so the iteration-wall claim in BENCH.md is always
+    reproducible from the artifact. The interesting number at the
+    large-grid end is ``speedup``: iterations go near-flat in
+    resolution (Briggs/Henson/McCormick, PAPERS.md) while Jacobi's
+    double per refinement."""
+    import jax.numpy as jnp
+
+    from poisson_tpu import obs
+    from poisson_tpu.mg import DEFAULT_MG, validate_mg_problem
+    from poisson_tpu.obs.costs import mg_vcycle_cost
+    from poisson_tpu.solvers.pcg import pcg_solve
+    from poisson_tpu.utils.timing import fence, mlups
+
+    try:
+        validate_mg_problem(problem)
+    except ValueError as e:
+        print(f"bench: {e}", file=sys.stderr)
+        return 2
+    dtype = jnp.float32
+
+    def jac_run(gate=None):
+        return pcg_solve(problem, dtype=dtype, rhs_gate=gate)
+
+    def mg_run(gate=None):
+        return pcg_solve(problem, dtype=dtype, rhs_gate=gate,
+                         preconditioner="mg")
+
+    with obs.span("bench.preconditioner_warmup", fence=False,
+                  preconditioner=preconditioner):
+        t0 = time.perf_counter()
+        rj = jac_run()
+        fence(rj)
+        rm = mg_run()          # includes the hierarchy build + compile
+        fence(rm)
+        compile_and_first = time.perf_counter() - t0
+    obs.inc("time.compile_seconds", compile_and_first)
+
+    def chain(run, k: int) -> float:
+        t0 = time.perf_counter()
+        res = run()
+        for _ in range(k - 1):
+            gate = 1.0 + 0.0 * res.diff.astype(jnp.float32)
+            res = run(gate)
+        fence(res.iterations)
+        return time.perf_counter() - t0
+
+    with obs.span("bench.preconditioner_timed", fence=False):
+        tj = (min(chain(jac_run, K_HI) for _ in range(3))
+              - min(chain(jac_run, K_LO) for _ in range(3)))
+        tm = (min(chain(mg_run, K_HI) for _ in range(3))
+              - min(chain(mg_run, K_LO) for _ in range(3)))
+    if tj <= 0 or tm <= 0:
+        print(f"bench: non-positive slope (jacobi {tj:.4f}s, mg "
+              f"{tm:.4f}s); falling back to whole-chain timing",
+              file=sys.stderr)
+        if tj <= 0:
+            tj = chain(jac_run, K_HI) * (K_HI - K_LO) / K_HI
+        if tm <= 0:
+            tm = chain(mg_run, K_HI) * (K_HI - K_LO) / K_HI
+    per = K_HI - K_LO
+    jac_s, mg_s = tj / per, tm / per
+    jac_mlups = mlups(problem, int(rj.iterations), jac_s)
+    mg_mlups = mlups(problem, int(rm.iterations), mg_s)
+    cycle = mg_vcycle_cost(problem.M, problem.N,
+                           jnp.dtype(dtype).itemsize, DEFAULT_MG)
+    headline_mlups = mg_mlups if preconditioner == "mg" else jac_mlups
+    headline = rm if preconditioner == "mg" else rj
+    headline_s = mg_s if preconditioner == "mg" else jac_s
+    record = {
+        "metric": "mlups",
+        "value": round(headline_mlups, 1),
+        "unit": "MLUPS",
+        "detail": {
+            "grid": [problem.M, problem.N],
+            "iterations": int(headline.iterations),
+            "solve_seconds": round(headline_s, 4),
+            "first_run_seconds": round(compile_and_first, 2),
+            "dtype": jnp.dtype(dtype).name,
+            "backend": "xla",
+            "devices": len(devices),
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "platform_fallback": downgraded,
+            # Experiment identity for the sentinel: preconditioner
+            # records form their own cohort (regress.cohort_key) — MG
+            # MLUPS never indict the Jacobi baseline, and vice versa.
+            "preconditioner": preconditioner,
+            "preconditioner_ab": {
+                "jacobi": {"iterations": int(rj.iterations),
+                           "solve_seconds": round(jac_s, 4),
+                           "mlups": round(jac_mlups, 1)},
+                "mg": {"iterations": int(rm.iterations),
+                       "solve_seconds": round(mg_s, 4),
+                       "mlups": round(mg_mlups, 1),
+                       "levels": cycle["levels"],
+                       "coarse_dense": cycle["coarse_dense"],
+                       "vcycle_passes_model": round(
+                           cycle["passes_fine_equivalent"], 2)},
+                "iteration_ratio": round(
+                    int(rj.iterations) / max(1, int(rm.iterations)), 2),
+                "speedup": round(jac_s / mg_s, 2) if mg_s > 0 else None,
+            },
+        },
+    }
+    obs.event("bench.preconditioner_record",
+              grid=f"{problem.M}x{problem.N}",
+              preconditioner=preconditioner,
+              jacobi_iterations=int(rj.iterations),
+              mg_iterations=int(rm.iterations),
+              speedup=record["detail"]["preconditioner_ab"]["speedup"])
+    obs.finalize()
+    print(json.dumps(record))
+    return 0
+
+
 def main() -> int:
     downgraded, probe_failures = _acquire_backend()
     _adopt_layout_decision()
@@ -1217,6 +1342,20 @@ def main() -> int:
         if verify_every_arg < 1:
             print(f"--verify-every must be >= 1, got {verify_every_arg}",
                   file=sys.stderr)
+            return 2
+    preconditioner_arg = None
+    if "--preconditioner" in argv:
+        i = argv.index("--preconditioner")
+        try:
+            preconditioner_arg = argv[i + 1]
+        except IndexError:
+            print("usage: python bench.py --preconditioner {jacobi,mg} "
+                  "[M N]", file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if preconditioner_arg not in ("jacobi", "mg"):
+            print(f"--preconditioner must be jacobi or mg, got "
+                  f"{preconditioner_arg!r}", file=sys.stderr)
             return 2
     serve_requests = None
     if "--serve" in argv:
@@ -1316,12 +1455,19 @@ def main() -> int:
         print("--verify-every is its own bench mode; drop --batch/--serve",
               file=sys.stderr)
         return 2
+    if preconditioner_arg is not None and (
+            batch is not None or serve_requests is not None
+            or verify_every_arg is not None):
+        print("--preconditioner is its own A/B bench mode; drop "
+              "--batch/--serve/--verify-every", file=sys.stderr)
+        return 2
     if len(argv) == 2:
         problem = Problem(M=int(argv[0]), N=int(argv[1]))
     elif len(argv) == 0:
         problem = (Problem(M=400, N=600)
                    if batch is not None or serve_requests is not None
                    or verify_every_arg is not None
+                   or preconditioner_arg is not None
                    else Problem(M=800, N=1200))
     else:
         print("usage: python bench.py [--batch B | --serve R] [M N]",
@@ -1361,6 +1507,9 @@ def main() -> int:
     if verify_every_arg is not None:
         return _verify_bench(problem, verify_every_arg, devices, platform,
                              downgraded=downgraded)
+    if preconditioner_arg is not None:
+        return _preconditioner_bench(problem, preconditioner_arg, devices,
+                                     platform, downgraded=downgraded)
     if batch is not None:
         return _batched_bench(problem, batch, devices, platform,
                               downgraded=downgraded)

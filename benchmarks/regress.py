@@ -50,7 +50,12 @@ cohort key — the direction pin for the SDC defense: a solve paying the
 in-loop verification probe is a different experiment from an unverified
 one, so a verified run can never indict an unverified baseline and an
 unverified run can never mask a verified-path slowdown (pinned by
-tests/test_integrity.py).
+tests/test_integrity.py). Preconditioner records (``bench.py
+--preconditioner mg``) carry ``detail.preconditioner`` in the cohort
+key: an MG-preconditioned iteration deliberately trades per-iteration
+bytes for a near-flat iteration count, so its MLUPS are a different
+experiment — MG runs never judge Jacobi baselines, and vice versa
+(pinned by tests/test_mg.py).
 
 Stdlib only, no jax import: like the forensics renderer, a post-session
 gate must never risk initializing a backend.
@@ -98,6 +103,7 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                workers: Optional[int] = None,
                geometry_mix: Optional[int] = None,
                verify_every: Optional[int] = None,
+               preconditioner: Optional[str] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -132,6 +138,12 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # for its drift checks by design, so it never indicts an
         # unverified baseline (and cannot hide behind one). Cohort key.
         "verify_every": verify_every,
+        # Preconditioner records (bench.py --preconditioner mg): the
+        # preconditioner is experiment identity — an MG iteration moves
+        # several times the bytes of a Jacobi iteration by design
+        # (V-cycle traffic), so its MLUPS live in their own cohort: MG
+        # runs never judge Jacobi baselines, and vice versa. Cohort key.
+        "preconditioner": preconditioner,
         "failed": bool(failed),
         "note": note,
     }
@@ -168,6 +180,7 @@ def record_from_result(result: dict, source: str,
         workers=det.get("workers"),
         geometry_mix=det.get("geometry_mix"),
         verify_every=det.get("verify_every"),
+        preconditioner=det.get("preconditioner"),
     )
 
 
@@ -258,17 +271,19 @@ def cohort_key(rec: dict):
     grid, same dtype, same platform/backend/device-count — and, for
     service-mode records, the same injected fault load, the same
     open-loop arrival rate, the same fleet worker count, the same
-    geometry-mix family count, AND the same integrity-probe stride
-    (fault-load runs are never judged against clean baselines;
-    throughput at one offered load is a different experiment from
-    another; a W-worker fleet never judges a single-worker baseline; a
-    K-family mixed-geometry load never judges a single-ellipse one; a
-    verified solve never indicts an unverified baseline)."""
+    geometry-mix family count, the same integrity-probe stride, AND the
+    same preconditioner (fault-load runs are never judged against clean
+    baselines; throughput at one offered load is a different experiment
+    from another; a W-worker fleet never judges a single-worker
+    baseline; a K-family mixed-geometry load never judges a
+    single-ellipse one; a verified solve never indicts an unverified
+    baseline; an MG run never judges a Jacobi one, or vice versa)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
             rec.get("devices"), rec.get("fault_load"),
             rec.get("arrival_rate"), rec.get("workers"),
-            rec.get("geometry_mix"), rec.get("verify_every"))
+            rec.get("geometry_mix"), rec.get("verify_every"),
+            rec.get("preconditioner"))
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

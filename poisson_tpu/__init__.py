@@ -28,6 +28,14 @@ the fictitious-domain method — see SURVEY.md):
                  breaking, and a graceful-degradation ladder — chaos-
                  tested (``testing.chaos``; ``python -m poisson_tpu
                  chaos --all``) against the no-lost-request invariant.
+- ``mg``       — geometric multigrid preconditioning
+                 (``preconditioner="mg"``): a symmetric V-cycle over
+                 coarsened copies of the same fictitious-domain blend
+                 canvases, plugged into the shared PCG body through the
+                 ``apply_Dinv`` seam — near-flat iteration counts in
+                 resolution where the Jacobi diagonal's double per
+                 refinement (the measured 10–50× lever at the
+                 large-grid end; README "Multigrid preconditioning").
 
 The single-device solver is the stage0/stage1 equivalent; the sharded solver is
 the stage2/3/4 equivalent; Pallas kernels play the role of stage4's CUDA kernels.

@@ -170,6 +170,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "@file.json (poisson_tpu.geometry; single-device "
                         "xla backend). Preview specs with `python -m "
                         "poisson_tpu geometry SPEC`")
+    p.add_argument("--preconditioner", choices=("jacobi", "mg"),
+                   default="jacobi",
+                   help="M^-1 for the CG recurrence: jacobi (the "
+                        "historical diagonal; default, byte-identical "
+                        "executables) or mg — one geometric V-cycle per "
+                        "iteration (poisson_tpu.mg: near-flat iteration "
+                        "counts in resolution; xla-family backends only; "
+                        "the grid must coarsen, i.e. even M and N). "
+                        "Check the cycle with `python -m "
+                        "poisson_tpu.mg.selfcheck`")
     p.add_argument("--checkpoint", metavar="PATH", default=None,
                    help="persist solver state to PATH every --chunk "
                         "iterations and resume from it (every JAX backend; "
@@ -329,6 +339,11 @@ def _pick_backend(args) -> str:
         # --geometry likewise: the geometry canvases ride the
         # single-device xla solve (the pallas/sharded paths bake the
         # reference ellipse).
+        return "xla"
+    if getattr(args, "preconditioner", "jacobi") == "mg":
+        # --preconditioner mg likewise: the V-cycle rides the xla solve
+        # body (poisson_tpu.mg); the pallas kernels and sharded meshes
+        # have no MG program yet and reject it loudly when forced.
         return "xla"
     devices = jax.devices()
     tpu = devices[0].platform == "tpu"
@@ -596,6 +611,7 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
             stream_every=stream_every,
             watchdog=watchdog, on_chunk=on_chunk,
             verify_every=args.verify_every, verify_tol=args.verify_tol,
+            preconditioner=args.preconditioner,
         )
         n_dev = 1
     elif args.checkpoint:
@@ -608,6 +624,7 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
             stream_every=stream_every,
             watchdog=watchdog, on_chunk=on_chunk,
             verify_every=args.verify_every, verify_tol=args.verify_tol,
+            preconditioner=args.preconditioner,
         )
         n_dev = 1
     else:
@@ -618,7 +635,8 @@ def _run_jax(args, problem: Problem, backend: str, watchdog=None,
         run = lambda: pcg_solve(problem, dtype=args.dtype,
                                 stream_every=stream_every, geometry=geom,
                                 verify_every=args.verify_every,
-                                verify_tol=args.verify_tol)
+                                verify_tol=args.verify_tol,
+                                preconditioner=args.preconditioner)
         n_dev = 1
 
     from poisson_tpu import obs
@@ -773,6 +791,14 @@ def build_batched_parser() -> argparse.ArgumentParser:
                         "member stops alone with an 'integrity' verdict "
                         "while its batchmates solve on; 0 (default) "
                         "keeps the historical executables byte-for-byte")
+    p.add_argument("--preconditioner", choices=("jacobi", "mg"),
+                   default="jacobi",
+                   help="per-member M^-1: jacobi (the historical "
+                        "diagonal; default) or mg — one geometric "
+                        "V-cycle per iteration (poisson_tpu.mg, "
+                        "near-flat iteration counts in resolution; the "
+                        "grid must coarsen: even M and N). mg does not "
+                        "combine with --geometry yet")
     p.add_argument("--verify-tol", type=float, default=None,
                    help="relative drift tolerance for --verify-every "
                         "(default: dtype-aware)")
@@ -842,11 +868,24 @@ def _main_solve_batched(argv) -> int:
     if args.verify_tol is not None and not args.verify_every:
         raise SystemExit("--verify-tol tunes the integrity probe; pass "
                          "--verify-every K to arm it")
+    if args.preconditioner == "mg":
+        if geometries is not None:
+            raise SystemExit(
+                "--preconditioner mg does not co-batch --geometry "
+                "members yet (each would need its own level hierarchy); "
+                "drop one of the two")
+        from poisson_tpu.mg import validate_mg_problem
+
+        try:
+            validate_mg_problem(problem)
+        except ValueError as e:
+            raise SystemExit(f"--preconditioner mg: {e}")
     run = lambda: solve_batched(problem, rhs_gates=gates,
                                 dtype=args.dtype, bucket=args.bucket,
                                 geometries=geometries,
                                 verify_every=args.verify_every,
-                                verify_tol=args.verify_tol)
+                                verify_tol=args.verify_tol,
+                                preconditioner=args.preconditioner)
     timer = PhaseTimer()
     with timer.phase("compile_and_first_solve"):
         result = run()
@@ -877,6 +916,8 @@ def _main_solve_batched(argv) -> int:
     }
     if args.verify_every:
         record["verify_every"] = args.verify_every
+    if args.preconditioner != "jacobi":
+        record["preconditioner"] = args.preconditioner
     if geometries is not None:
         record["geometry_mix"] = len(args.geometry)
         record["geometries"] = sorted({g.fingerprint for g in geometries})
@@ -884,7 +925,8 @@ def _main_solve_batched(argv) -> int:
     if args.compare_sequential:
         geos = geometries or [None] * B
         seq = lambda g, geo: pcg_solve(problem, dtype=args.dtype,
-                                       rhs_gate=g, geometry=geo)
+                                       rhs_gate=g, geometry=geo,
+                                       preconditioner=args.preconditioner)
         fence(seq(gates[0], geos[0]))  # compile once outside the timing
         with obs.span("timed_sequential_solves", fence=False, batch=B):
             t0 = time.perf_counter()
@@ -965,6 +1007,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "specs, forming a mixed-geometry load whose "
                         "families co-batch per bucket executable "
                         "(fingerprints ride the flight traces)")
+    p.add_argument("--preconditioner", choices=("jacobi", "mg"),
+                   default="jacobi",
+                   help="service-wide default M^-1 "
+                        "(ServicePolicy.preconditioner): mg runs every "
+                        "request with the geometric V-cycle "
+                        "(poisson_tpu.mg) in its own :mg cohort family "
+                        "— separate bucket executables, breakers and "
+                        "sentinel baselines; the grid must coarsen "
+                        "(even M and N)")
     p.add_argument("--continuous", action="store_true",
                    help="continuous-batching scheduling: a lane table "
                         "steps the fused program chunk by chunk, "
@@ -1094,6 +1145,13 @@ def _main_serve(argv) -> int:
                          f"got {args.verify_every}")
     from poisson_tpu.integrity import IntegrityPolicy
 
+    if args.preconditioner == "mg":
+        from poisson_tpu.mg import validate_mg_problem
+
+        try:
+            validate_mg_problem(problem)
+        except ValueError as e:
+            raise SystemExit(f"--preconditioner mg: {e}")
     policy = ServicePolicy(
         capacity=args.capacity, max_batch=args.max_batch,
         default_chunk=args.chunk or 50,
@@ -1103,6 +1161,7 @@ def _main_serve(argv) -> int:
         fleet=FleetPolicy(workers=args.workers),
         integrity=IntegrityPolicy(verify_every=args.verify_every,
                                   verify_tol=args.verify_tol),
+        preconditioner=args.preconditioner,
     )
     journal = (SolveJournal(args.journal) if args.journal else None)
     if args.recover:
@@ -1157,6 +1216,8 @@ def _main_serve(argv) -> int:
         "M": problem.M, "N": problem.N, "requests": args.requests,
         "scheduling": svc.policy.scheduling,
         "workers": args.workers,
+        **({"preconditioner": args.preconditioner}
+           if args.preconditioner != "jacobi" else {}),
         **({"geometry_mix": len(geo_specs),
             "geometries": sorted({g.fingerprint for g in geo_specs})}
            if geo_specs else {}),
@@ -1587,6 +1648,11 @@ def main(argv=None) -> int:
             "--geometry drives the single-device xla solve; the native "
             "C++ path bakes the reference ellipse"
         )
+    if args.preconditioner == "mg" and args.backend == "native":
+        raise SystemExit(
+            "--preconditioner mg drives the JAX xla solve body "
+            "(poisson_tpu.mg); not available with --backend native"
+        )
 
     if args.dtype == "float64" and args.backend != "native":
         import jax
@@ -1665,6 +1731,20 @@ def main(argv=None) -> int:
                 f"chunked paths take the detection, watchdog and "
                 f"checkpoint-hardening flags via --checkpoint"
             )
+        if args.preconditioner == "mg":
+            if backend != "xla":
+                raise SystemExit(
+                    f"--preconditioner mg drives the single-device xla "
+                    f"solve body (resolved backend: {backend}); the "
+                    f"pallas kernels and sharded meshes have no MG "
+                    f"program yet — drop the flag or use --backend xla"
+                )
+            from poisson_tpu.mg import validate_mg_problem
+
+            try:
+                validate_mg_problem(problem)
+            except ValueError as e:
+                raise SystemExit(f"--preconditioner mg: {e}")
         # The chunk-boundary hooks exist on the XLA chunked drivers; a
         # resilience flag that cannot reach one must not be silently
         # dropped (the same no-silent-drop rule the geometry flags follow).

@@ -163,13 +163,19 @@ def case_by_name(name: str) -> ManufacturedCase:
 
 
 def manufactured_error(case: ManufacturedCase, M: int, N: int,
-                       dtype=None) -> dict:
+                       dtype=None, preconditioner: str = "jacobi") -> dict:
     """Run ``case`` end to end on an M×N grid and measure the weighted
     L2 error over nodes strictly inside D (the BENCH.md oracle rule,
     applied to the family's own exact solution).
 
     Returns ``{"l2", "rel", "iterations", "flag"}`` — ``rel`` is the
-    error relative to ‖u‖, the number the per-family floor gates."""
+    error relative to ‖u‖, the number the per-family floor gates.
+
+    ``preconditioner="mg"`` runs the SAME oracle through the V-cycle-
+    preconditioned solve (:mod:`poisson_tpu.mg`) — the hierarchy is
+    built from exactly the case's own canvases — which is how every
+    geometry family gates MG at its established L2 floor before MG may
+    serve that family (the PR 9 gating rule, generalized verbatim)."""
     import jax.numpy as jnp
 
     from poisson_tpu.geometry.canvas import build_geometry_fields
@@ -194,9 +200,25 @@ def manufactured_error(case: ManufacturedCase, M: int, N: int,
         rhs_use = rhs64
         aux64 = np.pad(d64, 1)
     dt = jnp.dtype(dtype_name)
-    result = _solve(problem, use_scaled, 0, 0, 0.0, False,
-                    jnp.asarray(a64, dt), jnp.asarray(b64, dt),
-                    jnp.asarray(rhs_use, dt), jnp.asarray(aux64, dt))
+    if preconditioner not in (None, "jacobi"):
+        from poisson_tpu.mg import (
+            DEFAULT_MG,
+            hierarchy_from_fields,
+            resolve_preconditioner,
+        )
+        from poisson_tpu.mg.preconditioner import _solve_mg
+
+        resolve_preconditioner(preconditioner)
+        hier = hierarchy_from_fields(problem, a64, b64, dtype_name,
+                                     use_scaled, DEFAULT_MG)
+        result = _solve_mg(problem, use_scaled, DEFAULT_MG, 0, 0, 0.0,
+                           jnp.asarray(a64, dt), jnp.asarray(b64, dt),
+                           jnp.asarray(rhs_use, dt),
+                           jnp.asarray(aux64, dt), hier)
+    else:
+        result = _solve(problem, use_scaled, 0, 0, 0.0, False,
+                        jnp.asarray(a64, dt), jnp.asarray(b64, dt),
+                        jnp.asarray(rhs_use, dt), jnp.asarray(aux64, dt))
 
     w = np.asarray(result.w, np.float64)
     i_idx = np.arange(problem.M + 1)

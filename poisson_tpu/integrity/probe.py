@@ -78,6 +78,36 @@ DEFAULT_VERIFY_JUMP = 50.0
 # two scalars already in the state, no extra device work.
 DEFAULT_VERIFY_COLLAPSE = 8.0
 
+# MG-preconditioned CG (poisson_tpu.mg) contracts much faster per
+# iteration than Jacobi-preconditioned CG — that is the whole point —
+# so the update-norm guard ratios calibrated on the Jacobi goldens
+# would read clean MG progress as corruption. Measured on clean MG
+# solves (f32 + f64, five grid sizes and every geometry family —
+# the calibration sweep is reproduced in tests/test_mg.py): the worst
+# clean one-step ‖Δw‖ drop is 28.6× and the worst convergence-event
+# best/diff ratio 11.9×. The MG ratios below sit a ≥4× margin above
+# the clean maxima while still catching the ×2¹⁶-and-up collapse an
+# exponent flip produces.
+DEFAULT_VERIFY_JUMP_MG = 200.0
+DEFAULT_VERIFY_COLLAPSE_MG = 128.0
+
+
+def default_verify_jump(preconditioner: str = "jacobi") -> float:
+    """The convergence-jump guard ratio for a preconditioner: genuine
+    final-step contraction is single digits under Jacobi, tens under
+    MG — the guard line moves with the preconditioner's clean
+    per-iteration contraction, or every fast clean convergence would
+    read as a collapsed α."""
+    return (DEFAULT_VERIFY_JUMP_MG if preconditioner == "mg"
+            else DEFAULT_VERIFY_JUMP)
+
+
+def default_verify_collapse(preconditioner: str = "jacobi") -> float:
+    """The mid-solve collapse guard ratio, preconditioner-calibrated
+    (same reasoning as :func:`default_verify_jump`)."""
+    return (DEFAULT_VERIFY_COLLAPSE_MG if preconditioner == "mg"
+            else DEFAULT_VERIFY_COLLAPSE)
+
 # Relative drift tolerances by state dtype. Clean recurrence-vs-true
 # drift grows like O(k·ε·κ-ish); these sit far above the clean floor
 # measured on the golden problems (tests pin zero false alarms, f32 and

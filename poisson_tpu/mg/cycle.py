@@ -1,0 +1,133 @@
+"""The geometric V-cycle: transfers, smoothing, and the cycle itself.
+
+Everything here is pure traced jnp over the ``ops.stencil`` array
+convention — full grids (…, M+1, N+1) with an identically-zero Dirichlet
+ring — and batch-polymorphic the same way the stencil library is:
+ellipsis indexing everywhere, so one implementation serves the solo
+solve, the leading-batch-axis stacks, and ``vmap``-ed per-member bodies
+(the batched/lane drivers) unchanged.
+
+The transfer pair is chosen for symmetry, not convenience: bilinear
+prolongation P (coincident copy, ½ edges, ¼ centres) and full-weighting
+restriction R (the 1/16·[1 2 1; 2 4 2; 1 2 1] stencil) satisfy
+R = ¼·Pᵀ exactly, so the coarse-grid correction P·A_c⁻¹·R is symmetric
+whenever A_c is — and weighted Jacobi is A-self-adjoint — making the
+whole V-cycle an SPD operator that plain CG may precondition with
+(Briggs/Henson/McCormick ch. 10, PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from poisson_tpu.mg.hierarchy import DEFAULT_MG, MGConfig, MGLevels
+from poisson_tpu.ops.stencil import apply_A, pad_interior
+
+
+def restrict_full_weighting(r):
+    """Fine (…, M+1, N+1) → coarse (…, M/2+1, N/2+1) by the 9-point
+    full-weighting stencil over interior coarse nodes (the ring stays
+    zero). Coarse node (I, J) sits on fine node (2I, 2J); the stencil
+    sums to 1, so the restricted residual keeps function-value
+    semantics — the rediscretized coarse operator consumes it directly.
+    """
+    c = r[..., 2:-1:2, 2:-1:2]                 # (2I, 2J)
+    up, dn = r[..., 1:-2:2, 2:-1:2], r[..., 3::2, 2:-1:2]
+    lf, rt = r[..., 2:-1:2, 1:-2:2], r[..., 2:-1:2, 3::2]
+    ul, ur = r[..., 1:-2:2, 1:-2:2], r[..., 1:-2:2, 3::2]
+    dl, dr = r[..., 3::2, 1:-2:2], r[..., 3::2, 3::2]
+    core = (4.0 * c + 2.0 * (up + dn + lf + rt)
+            + (ul + ur + dl + dr)) / 16.0
+    return pad_interior(core)
+
+
+def prolong_bilinear(e):
+    """Coarse (…, Mc+1, Nc+1) → fine (…, 2Mc+1, 2Nc+1) by bilinear
+    interpolation: coincident fine nodes copy, edge midpoints average
+    their 2 coarse neighbours, cell centres their 4 (as the tensor
+    product of two 1D linear interpolations — an interleave by
+    stack+reshape, which XLA lowers as cheap concatenation where the
+    equivalent strided ``.at[].set`` scatter costs ~50× on CPU). The
+    coarse ring is zero, so fine near-boundary nodes interpolate
+    against the Dirichlet value — the result's ring is zero by
+    construction."""
+    mid_r = 0.5 * (e[..., :-1, :] + e[..., 1:, :])
+    rows = jnp.stack([e[..., :-1, :], mid_r], axis=-2)
+    rows = rows.reshape(e.shape[:-2]
+                        + (2 * (e.shape[-2] - 1), e.shape[-1]))
+    ex = jnp.concatenate([rows, e[..., -1:, :]], axis=-2)
+    mid_c = 0.5 * (ex[..., :, :-1] + ex[..., :, 1:])
+    cols = jnp.stack([ex[..., :, :-1], mid_c], axis=-1)
+    cols = cols.reshape(ex.shape[:-1] + (2 * (ex.shape[-1] - 1),))
+    return jnp.concatenate([cols, ex[..., :, -1:]], axis=-1)
+
+
+def smooth_jacobi(x, rhs, a, b, dinv, h1: float, h2: float,
+                  sweeps: int, omega: float, from_zero: bool = False):
+    """``sweeps`` damped-Jacobi sweeps x ← x + ω·D⁻¹(rhs − Ax).
+
+    ``dinv`` is the zero-ring-padded inverse diagonal, so the update is
+    one fused elementwise expression and the ring stays untouched.
+    ``from_zero`` starts from x = 0 and folds the first sweep into the
+    cheap closed form ω·D⁻¹·rhs (no stencil application against a zero
+    iterate). Unrolled: ``sweeps`` is a small static constant."""
+    if from_zero:
+        if sweeps <= 0:
+            return jnp.zeros_like(rhs)
+        x = omega * dinv * rhs
+        sweeps -= 1
+    for _ in range(sweeps):
+        x = x + omega * dinv * (rhs - apply_A(x, a, b, h1, h2))
+    return x
+
+
+def coarse_solve(rhs, a, b, dinv, coarse_inv, h1: float, h2: float,
+                 config: MGConfig):
+    """The coarsest-level solve: the dense symmetrised inverse as one
+    interior matvec when it was built (``coarse_dense_limit``), else
+    ``coarse_sweeps`` smoother sweeps from zero. The matvec is
+    deliberately a broadcast-multiply + trailing-axis reduce rather
+    than a dot/einsum: XLA fuses it into one per-row accumulation loop
+    whose order is the same in the solo program, under ``vmap`` (the
+    batched/lane drivers), and inside any fusion context — a dot would
+    dispatch to shape-dependent GEMV/GEMM kernels whose accumulation
+    orders differ, and the bit-parity contract between the solo and
+    batched MG solves (tests/test_mg.py) rests on this reduction."""
+    if coarse_inv is None:
+        return smooth_jacobi(None, rhs, a, b, dinv, h1, h2,
+                             config.coarse_sweeps, config.omega,
+                             from_zero=True)
+    mc, nc = rhs.shape[-2] - 1, rhs.shape[-1] - 1
+    flat = rhs[..., 1:-1, 1:-1].reshape(rhs.shape[:-2]
+                                        + ((mc - 1) * (nc - 1),))
+    e = jnp.sum(coarse_inv * flat[..., None, :], axis=-1)
+    return pad_interior(e.reshape(rhs.shape[:-2] + (mc - 1, nc - 1)))
+
+
+def v_cycle(hier: MGLevels, r, h1: float, h2: float,
+            config: MGConfig = DEFAULT_MG):
+    """One V(ν₁, ν₂) cycle applied to the residual ``r``: z ≈ A⁻¹r.
+
+    Python recursion over the static level tuple — the cycle unrolls at
+    trace time (≤ ~7 levels for every supported grid). ``h1``/``h2``
+    are the finest spacings; each level doubles them. Symmetric by
+    construction (module docstring), so the result is an SPD
+    preconditioner application for the outer CG."""
+    levels = hier.levels
+
+    def cycle(lvl: int, rl):
+        a, b, dinv = levels[lvl]
+        h1l, h2l = h1 * (1 << lvl), h2 * (1 << lvl)
+        if lvl == len(levels) - 1:
+            return coarse_solve(rl, a, b, dinv, hier.coarse_inv,
+                                h1l, h2l, config)
+        x = smooth_jacobi(None, rl, a, b, dinv, h1l, h2l,
+                          config.pre_smooth, config.omega,
+                          from_zero=True)
+        res = rl - apply_A(x, a, b, h1l, h2l)
+        ec = cycle(lvl + 1, restrict_full_weighting(res))
+        x = x + prolong_bilinear(ec)
+        return smooth_jacobi(x, rl, a, b, dinv, h1l, h2l,
+                             config.post_smooth, config.omega)
+
+    return cycle(0, r)

@@ -137,6 +137,79 @@ def apportion_compute(span_seconds: float,
             for mid, k in member_iterations.items()}
 
 
+def mg_vcycle_cost(M: int, N: int, dtype_bytes: int = 4,
+                   config=None, scaled: bool = True) -> dict:
+    """Analytic HLO-operand traffic of ONE geometric V-cycle
+    (:mod:`poisson_tpu.mg`) — the per-iteration surcharge an
+    MG-preconditioned CG iteration pays over the Jacobi body, in the
+    same operand-pass units as :func:`analytic_iteration_cost`.
+
+    Per non-coarsest level (area 4^-l of the fine grid): the
+    first pre-smoothing sweep from zero is the closed form ω·D⁻¹r
+    (3 passes: read r, dinv, write x); every further damped-Jacobi
+    sweep is one stencil application (10: five shifted reads of x, a
+    and b twice each, one write) plus the fused update (5: read x, r,
+    dinv, Ax; write x) = 15; the residual costs 12 (stencil + fused
+    subtract); restriction 1.25 (read fine, write quarter-size
+    coarse); prolongation+correction 2.25. The coarsest level is
+    either the dense-inverse matvec — n² matrix reads, the constant
+    term that dominates small grids and vanishes relative to fine work
+    at scale — or ``coarse_sweeps`` smoother sweeps. Scaled solves add
+    the √d congruence wrap (4 fine passes).
+
+    Returns ``{"bytes", "flops", "passes_fine_equivalent", "levels",
+    "coarse_dense", "terms"}`` and sets the ``cost.mg.*`` gauges.
+    ``passes_fine_equivalent`` is total bytes over one fine-grid array
+    pass — the number roofline attribution adds to the CG body's pass
+    model so MG records cohort separately.
+    """
+    from poisson_tpu.mg.hierarchy import DEFAULT_MG, plan_levels
+
+    cfg = config or DEFAULT_MG
+    dims = plan_levels(M, N, cfg)
+    pts0 = grid_points(M, N)
+    sweep, first_sweep, residual, restrict, prolong = 15.0, 3.0, 12.0, 1.25, 2.25
+    per_level = (first_sweep + (cfg.pre_smooth - 1) * sweep
+                 if cfg.pre_smooth > 0 else 0.0)
+    per_level += residual + restrict + prolong + cfg.post_smooth * sweep
+    bytes_total = 0.0
+    flops_total = 0.0
+    for lvl, (m, n) in enumerate(dims[:-1]):
+        pts = grid_points(m, n)
+        bytes_total += per_level * pts * dtype_bytes
+        sweeps = cfg.pre_smooth + cfg.post_smooth
+        flops_total += (13.0 * sweeps + 12.0) * pts
+    mc, nc = dims[-1]
+    n_int = (mc - 1) * (nc - 1)
+    coarse_dense = n_int <= cfg.coarse_dense_limit
+    if coarse_dense:
+        bytes_total += float(n_int) * n_int * dtype_bytes
+        flops_total += 2.0 * n_int * n_int
+    else:
+        pts = grid_points(mc, nc)
+        bytes_total += cfg.coarse_sweeps * sweep * pts * dtype_bytes
+        flops_total += 13.0 * cfg.coarse_sweeps * pts
+    if scaled:
+        bytes_total += 4.0 * pts0 * dtype_bytes   # √d congruence wrap
+    report = {
+        "bytes": bytes_total,
+        "flops": flops_total,
+        "passes_fine_equivalent": bytes_total / (pts0 * dtype_bytes),
+        "levels": len(dims),
+        "coarse_dense": coarse_dense,
+        "terms": {
+            "per_level_passes": per_level,
+            "coarsest": f"{mc}x{nc}",
+            "coarse_dense_bytes": (float(n_int) * n_int * dtype_bytes
+                                   if coarse_dense else 0.0),
+        },
+    }
+    metrics.gauge("cost.mg.bytes_per_cycle", bytes_total)
+    metrics.gauge("cost.mg.flops_per_cycle", flops_total)
+    metrics.gauge("cost.mg.passes", report["passes_fine_equivalent"])
+    return report
+
+
 # -- compiled-executable introspection ----------------------------------
 
 
@@ -338,16 +411,23 @@ def platform_peak_gbps(device_kind: Optional[str]) -> Optional[float]:
 def roofline_summary(problem, backend: Optional[str], dtype_bytes: int,
                      iterations: int, solve_seconds: float,
                      device_kind: Optional[str] = None,
-                     devices: int = 1) -> dict:
+                     devices: int = 1,
+                     passes_override: Optional[float] = None) -> dict:
     """Achieved-vs-roofline attribution of one measured solve.
 
     ``achieved_gbps`` = effective bytes/iteration (backend pass model ×
     grid bytes) × iterations / seconds, per device; ``fraction`` divides
     by the platform ceiling when one is known (None otherwise — an
     honest "no ceiling on file" beats a made-up one). Sets the
-    ``roofline.*`` gauges.
+    ``roofline.*`` gauges. ``passes_override`` replaces the static
+    backend pass model for program families whose traffic is
+    config-dependent — the MG-preconditioned iteration's passes are the
+    CG body's plus :func:`mg_vcycle_cost`'s fine-equivalent, so MG
+    records never borrow the plain-CG model (and regress.py cohorts
+    them separately by ``detail.preconditioner`` anyway).
     """
-    passes = EFFECTIVE_PASSES.get(backend or "")
+    passes = (passes_override if passes_override is not None
+              else EFFECTIVE_PASSES.get(backend or ""))
     peak = platform_peak_gbps(device_kind)
     achieved = None
     if passes and solve_seconds and solve_seconds > 0 and iterations:

@@ -128,6 +128,19 @@ Naming convention (dotted, low cardinality):
   the ledger (``ServicePolicy.dedup``): a client retry or replayed
   submit whose ``request_id`` was already seen returns the original
   outcome instead of double-admitting;
+- ``mg.*`` — the geometric multigrid preconditioner
+  (:mod:`poisson_tpu.mg`, ``preconditioner="mg"``): ``mg.solves``
+  counts MG-preconditioned solves dispatched (batched members count
+  individually — read next to ``pcg.solves.*`` to see the rollout
+  fraction); ``mg.hierarchy_cache.hits`` / ``mg.hierarchy_cache.misses``
+  — the fingerprint-keyed device hierarchy cache
+  (``mg.hierarchy.device_hierarchy``): a **miss** pays the host-fp64
+  level build (coefficient coarsening per level + the dense coarsest
+  factorisation, the expensive part) + cast + transfer; a **hit**
+  reuses the device levels across solves, buckets, and lane tables of
+  the same (problem, dtype, geometry-fingerprint, config). Read next
+  to ``geom.cache.{hits,misses}`` — the same setup-reuse story, one
+  level up;
 - ``serve.slo.*`` — the flight recorder's SLO accounting
   (``obs.flight.SLOTracker``, objectives declared in
   ``serve.types.SLOPolicy``): ``serve.slo.good`` / ``serve.slo.bad``
@@ -146,6 +159,15 @@ counters and numeric gauges in Prometheus text format):
   PCG iteration body vs the analytic 5-point-stencil model;
 - ``cost.solve.{flops,bytes_accessed,peak_memory_bytes}`` — the whole
   jitted solve program;
+- ``cost.mg.{bytes_per_cycle,flops_per_cycle,passes}`` — the analytic
+  V-cycle traffic model (``obs.costs.mg_vcycle_cost``): what one MG
+  preconditioner application moves per CG iteration, the number that
+  cohorts MG records separately in roofline attribution;
+- ``mg.levels`` (hierarchy depth of the most recent build) and
+  ``mg.coarse_dense`` (1 when the coarsest level solves by the dense
+  inverse, 0 when it fell back to smoother sweeps — an audible
+  quality bit: the dense coarse solve is what makes the cycle
+  resolution-independent);
 - ``roofline.{achieved_gbps,peak_gbps,fraction}`` — measured throughput
   against the platform bandwidth ceiling;
 - ``export.http_port`` — the live ``/metrics`` endpoint's bound port;

@@ -533,6 +533,43 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in integ_parsed:
             return _fail(f"exposition lost the {prom_name} counter")
 
+    # 17. Multigrid preconditioning (runs LAST, clean registry): the
+    # V-cycle preconditioner beats Jacobi's iteration count at two
+    # resolutions while converging to the same δ, the second solve of
+    # a grid reuses the cached hierarchy, and the mg_* counters
+    # survive the Prometheus exposition round trip.
+    from poisson_tpu.mg import reset_hierarchy_cache
+
+    obs_metrics.reset()
+    reset_hierarchy_cache()
+    mg_iters = {}
+    for m, n in ((40, 40), (80, 80)):
+        pp = Problem(M=m, N=n)
+        rj = pcg_solve(pp)
+        rm = pcg_solve(pp, preconditioner="mg")
+        if int(rm.flag) != 1 or float(rm.diff) >= pp.delta:
+            return _fail(f"mg solve {m}x{n} did not converge: flag "
+                         f"{int(rm.flag)}, diff {float(rm.diff):.2e}")
+        if int(rm.iterations) * 2 > int(rj.iterations):
+            return _fail(
+                f"mg iteration win missing at {m}x{n}: mg "
+                f"{int(rm.iterations)} vs jacobi {int(rj.iterations)}")
+        mg_iters[f"{m}x{n}"] = (int(rj.iterations), int(rm.iterations))
+    pcg_solve(Problem(M=40, N=40), preconditioner="mg")  # rebuild → hit
+    if obs_metrics.get("mg.hierarchy_cache.hits") < 1 \
+            or obs_metrics.get("mg.hierarchy_cache.misses") != 2:
+        return _fail(
+            f"hierarchy cache arithmetic off: hits="
+            f"{obs_metrics.get('mg.hierarchy_cache.hits')}, misses="
+            f"{obs_metrics.get('mg.hierarchy_cache.misses')}")
+    mg_parsed = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_mg_solves",
+                      "poisson_tpu_mg_hierarchy_cache_hits",
+                      "poisson_tpu_mg_hierarchy_cache_misses",
+                      "poisson_tpu_mg_levels"):
+        if prom_name not in mg_parsed:
+            return _fail(f"exposition lost the {prom_name} metric")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -547,7 +584,9 @@ def run_selfcheck(out_dir: str) -> int:
           f"geometry ok ({int(geom_hits)} canvas-cache hits, mixed "
           f"co-batch on one executable), integrity ok "
           f"({int(detections)} detection -> {int(vrestarts)} verified "
-          f"restart, 0 false alarms, sdc-verified-restart green) "
+          f"restart, 0 false alarms, sdc-verified-restart green), "
+          f"multigrid ok ({', '.join(f'{g}: {j}->{m} it' for g, (j, m) in mg_iters.items())}, "
+          f"hierarchy cache hit) "
           f"({out_dir})")
     return 0
 

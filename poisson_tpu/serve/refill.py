@@ -50,7 +50,7 @@ class LaneTable:
     def __init__(self, cohort: str, problem, dtype, bucket: int,
                  chunk: int, worker_id: int = 0,
                  multi_geometry: bool = False, verify_every: int = 0,
-                 verify_tol=None):
+                 verify_tol=None, preconditioner: str = "jacobi"):
         self.cohort = cohort
         self.problem = problem
         self.worker_id = worker_id
@@ -61,10 +61,15 @@ class LaneTable:
         # turning defensive verification on (suspect-cohort taint)
         # applies it to the NEXT table, never retrofits a running one.
         self.verify_every = int(verify_every)
+        # The preconditioner is program identity too (the :mg cohort
+        # marker means a table is only ever offered same-preconditioner
+        # entries; carried here so the lane programs match the cohort).
+        self.preconditioner = preconditioner or "jacobi"
         self.batch = LaneBatch(
             problem, bucket, dtype=dtype, chunk=chunk,
             multi_geometry=multi_geometry,
             verify_every=verify_every, verify_tol=verify_tol,
+            preconditioner=self.preconditioner,
             # Chunk-boundary hook (solvers.lanes): each boundary is a
             # timeline event, so a wedged lane program's last boundary
             # is on disk for forensics — attributed to the worker that

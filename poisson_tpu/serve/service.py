@@ -276,6 +276,20 @@ class SolveService:
         # scoped to ids that actually came through a replay
         # (_recovered_ids) — outside recovery, distinct ids that merely
         # collide under str() (1 vs "1") stay distinct requests.
+        # Preconditioner validation happens AT ADMISSION, loudly: an MG
+        # request on an uncoarsenable grid (odd dimensions) would
+        # otherwise burn a dispatch and surface as an opaque internal
+        # error; a typo'd preconditioner name must never silently run
+        # jacobi. Same caller-bug contract as the duplicate-id check.
+        pre = request.preconditioner or self.policy.preconditioner
+        if pre not in (None, "jacobi"):
+            from poisson_tpu.mg import (
+                resolve_preconditioner,
+                validate_mg_problem,
+            )
+
+            resolve_preconditioner(pre)
+            validate_mg_problem(request.problem)
         rid = request.request_id
         recovered_twin = str(rid) in self._recovered_ids
         seen = (rid in self._outcomes or rid in self._prior_outcomes
@@ -383,12 +397,14 @@ class SolveService:
                 self._warm_worker(worker, sticky)
 
     def _note_sticky(self, worker: Worker, cohort: str, problem, dtype,
-                     bucket=None) -> None:
+                     bucket=None, preconditioner: str = "jacobi") -> None:
         """Record that ``worker`` holds ``cohort``'s executable at
         ``bucket`` width — what routing prefers and restart warm-up
-        recompiles."""
+        recompiles (the preconditioner is executable identity, so the
+        warm-up must rebuild the same program family)."""
         info = worker.sticky.setdefault(
-            cohort, {"problem": problem, "dtype": dtype, "buckets": set()})
+            cohort, {"problem": problem, "dtype": dtype, "buckets": set(),
+                     "preconditioner": preconditioner})
         if bucket:
             info["buckets"].add(int(bucket))
 
@@ -409,7 +425,9 @@ class SolveService:
                 try:
                     solve_batched(info["problem"],
                                   rhs_gates=[0.0] * width,
-                                  dtype=info["dtype"], bucket=width)
+                                  dtype=info["dtype"], bucket=width,
+                                  preconditioner=info.get(
+                                      "preconditioner", "jacobi"))
                     obs.inc("serve.fleet.warmup_solves")
                 except Exception as e:   # warm-up is best-effort
                     obs.inc("serve.fleet.warmup_failures")
@@ -591,9 +609,21 @@ class SolveService:
 
     # -- batching ------------------------------------------------------
 
+    def _precond(self, request: SolveRequest) -> str:
+        """The request's effective preconditioner: its own knob, else
+        the service default."""
+        return request.preconditioner or self.policy.preconditioner
+
     def _cohort(self, request: SolveRequest) -> str:
         p = request.problem
         base = f"{p.M}x{p.N}:{request.dtype or 'auto'}:xla"
+        # MG requests are their own cohort family: different
+        # executables (V-cycle traced into the body), different cost
+        # profile, so their own breaker state and — downstream — their
+        # own sentinel baselines (benchmarks/regress.py): an MG rollout
+        # never indicts the Jacobi fleet, and vice versa.
+        if self._precond(request) == "mg":
+            base += ":mg"
         # Geometry requests form their own cohorts — the executable
         # family differs (stacked canvases) — but the FINGERPRINT stays
         # out of the key: different geometries on the same grid share
@@ -662,11 +692,16 @@ class SolveService:
 
     def _solo(self, entry: _Entry) -> bool:
         """Chunked single-request dispatch classes: deadline-carrying
-        (expiry needs chunk boundaries), explicitly chunked, or escalated
-        divergence retries (the resilient driver is single-request)."""
+        (expiry needs chunk boundaries), explicitly chunked, escalated
+        divergence retries (the resilient driver is single-request), or
+        MG+geometry requests (per-member hierarchies do not co-batch —
+        ``solvers.batched`` rejects the combination loudly, so the
+        service routes it through the chunked solo path instead)."""
         return (entry.deadline is not None
                 or entry.request.chunk is not None
-                or entry.escalate)
+                or entry.escalate
+                or (entry.request.geometry is not None
+                    and self._precond(entry.request) == "mg"))
 
     def _form_batch(self, head: _Entry) -> List[_Entry]:
         if self._solo(head):
@@ -730,9 +765,12 @@ class SolveService:
     def _lane_eligible(self, entry: _Entry) -> bool:
         """Continuous mode: deadline-carrying requests ride lanes (the
         engine's chunk boundary IS the deadline check), so only
-        explicitly-chunked requests and escalated divergence retries
-        (the resilient driver is single-request) still dispatch solo."""
-        return entry.request.chunk is None and not entry.escalate
+        explicitly-chunked requests, escalated divergence retries (the
+        resilient driver is single-request), and MG+geometry requests
+        (per-lane hierarchies do not exist yet) still dispatch solo."""
+        return (entry.request.chunk is None and not entry.escalate
+                and not (entry.request.geometry is not None
+                         and self._precond(entry.request) == "mg"))
 
     def _effective_dtype(self, entry: _Entry, level: int) -> str:
         """The dtype a lane splice would run this entry at — the
@@ -746,6 +784,8 @@ class SolveService:
     def _lane_cohort(self, entry: _Entry, level: int) -> str:
         p = entry.request.problem
         base = f"{p.M}x{p.N}:{self._effective_dtype(entry, level)}:xla"
+        if self._precond(entry.request) == "mg":
+            base += ":mg"
         # Same rule as _cohort: the :geo marker splits executables, the
         # fingerprint never does — mixed geometries share the lane table.
         return base + (":geo" if entry.request.geometry is not None
@@ -874,10 +914,12 @@ class SolveService:
                 worker_id=worker.id,
                 multi_geometry=head.request.geometry is not None,
                 verify_every=verify_every, verify_tol=verify_tol,
+                preconditioner=self._precond(head.request),
             )
             self._note_sticky(worker, head_cohort, head.request.problem,
                               None if eff_dtype == "auto" else eff_dtype,
-                              bucket)
+                              bucket,
+                              preconditioner=self._precond(head.request))
             obs.event("serve.refill.table", cohort=head_cohort,
                       bucket=bucket, level=level, worker=worker.id)
         if not table.free_lane_count():
@@ -1103,7 +1145,8 @@ class SolveService:
 
             width = bucket_size(len(batch))
         self._note_sticky(worker, cohort, head.request.problem,
-                          head.request.dtype, width)
+                          head.request.dtype, width,
+                          preconditioner=self._precond(head.request))
         # Flight: members leave the queue and become resident in one
         # shared dispatch — the dispatch id is the causal parent linking
         # every member's residency span and chunk-step points.
@@ -1195,6 +1238,8 @@ class SolveService:
         geoms = [e.request.geometry for e in batch]
         verify_every, verify_tol = self._verify_params(batch)
         self._count_defensive_verify(verify_every)
+        # The batch is cohort-homogeneous (the :mg marker splits
+        # cohorts), so the head's preconditioner is everyone's.
         result = solve_batched(
             problem,
             rhs_gates=[e.request.rhs_gate for e in batch],
@@ -1204,6 +1249,7 @@ class SolveService:
             geometries=(geoms if any(g is not None for g in geoms)
                         else None),
             verify_every=verify_every, verify_tol=verify_tol,
+            preconditioner=self._precond(batch[0].request),
         )
         co_ids = {e.request.request_id for e in batch}
         co_fps = _geo_fps(batch)
@@ -1271,6 +1317,7 @@ class SolveService:
                     solo_problem, dtype=dtype, chunk=chunk,
                     deadline=entry.deadline, on_chunk=req.on_chunk,
                     verify_every=verify_every, verify_tol=verify_tol,
+                    preconditioner=self._precond(req),
                 )
             except DivergenceError as e:
                 secs = max(0.0, self._clock() - t_disp)
@@ -1290,6 +1337,7 @@ class SolveService:
                 rhs_gate=(req.rhs_gate if req.geometry is not None
                           else None),
                 verify_every=verify_every, verify_tol=verify_tol,
+                preconditioner=self._precond(req),
             )
         # Flight: a solo dispatch's whole wall is this member's compute
         # (it shares the program with nobody).

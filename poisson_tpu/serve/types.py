@@ -81,6 +81,14 @@ class SolveRequest:
     family implicated in a batch kill never re-co-batches with the
     batchmates it took down. ``None`` is the reference ellipse path,
     byte-identical to every prior release.
+
+    ``preconditioner`` selects the request's M⁻¹ (``"jacobi"`` |
+    ``"mg"`` — :mod:`poisson_tpu.mg`; None defers to
+    ``ServicePolicy.preconditioner``). MG requests form their own
+    ``…:mg`` cohorts — separate bucket executables, separate breakers,
+    separate sentinel baselines — so an MG rollout can never indict (or
+    hide behind) the Jacobi fleet; MG+geometry requests dispatch solo
+    (per-member hierarchies do not co-batch yet).
     """
 
     request_id: Union[int, str]
@@ -92,6 +100,7 @@ class SolveRequest:
     max_attempts: Optional[int] = None
     on_chunk: Optional[Callable] = None
     geometry: Optional[object] = None     # geometry.dsl.GeometrySpec
+    preconditioner: Optional[str] = None  # None -> policy default
 
 
 @dataclasses.dataclass(frozen=True)
@@ -291,6 +300,12 @@ class ServicePolicy:
     instead of raising — so a client retry or a replayed submission can
     never double-admit. Off by default: with deduplication off, a
     recycled id is a caller bug and stays a loud ``ValueError``.
+
+    ``preconditioner`` is the service-wide default M⁻¹ for requests
+    that do not set their own (``"jacobi"`` keeps every prior release's
+    executables; ``"mg"`` makes the V-cycle the fleet default —
+    requests on uncoarsenable grids are then rejected loudly at
+    submission rather than failing inside a dispatch).
     """
 
     capacity: int = 64
@@ -299,6 +314,7 @@ class ServicePolicy:
     scheduling: str = SCHED_DRAIN
     refill_chunk: int = 25
     dedup: bool = False
+    preconditioner: str = "jacobi"
     retry: RetryPolicy = RetryPolicy()
     breaker: BreakerPolicy = BreakerPolicy()
     degradation: DegradationPolicy = DegradationPolicy()

@@ -89,7 +89,8 @@ def bucket_size(n: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
 def pcg_loop_batched(ops: PCGOps, rhs_stack, *, delta: float, max_iter: int,
                      weighted_norm: bool, h1: float, h2: float,
                      stagnation_window: int = 0, verify_every: int = 0,
-                     verify_tol: float = 0.0) -> PCGState:
+                     verify_tol: float = 0.0,
+                     preconditioner: str = "jacobi") -> PCGState:
     """Run the shared PCG body over a (B, M+1, N+1) RHS stack in ONE fused
     ``while_loop`` with per-member convergence masking.
 
@@ -120,6 +121,7 @@ def pcg_loop_batched(ops: PCGOps, rhs_stack, *, delta: float, max_iter: int,
             ops, delta=delta, weighted_norm=weighted_norm, h1=h1, h2=h2,
             stagnation_window=stagnation_window,
             verify_every=verify_every, verify_tol=verify_tol,
+            preconditioner=preconditioner,
         )
         vpair = jax.vmap(member, in_axes=(0, 0))
         vbody = lambda s: vpair(s, rhs_stack)
@@ -335,7 +337,9 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
                   member_ids: Optional[Sequence] = None,
                   geometries: Optional[Sequence] = None,
                   verify_every: int = 0,
-                  verify_tol=None) -> PCGResult:
+                  verify_tol=None,
+                  preconditioner: str = "jacobi",
+                  mg_config=None) -> PCGResult:
     """Solve a batch of Poisson problems in one fused device program.
 
     Input forms (exactly one):
@@ -394,6 +398,23 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     verdicts too. The stride is part of the executable identity, so
     verified buckets form their own bucket-cache key family and
     ``verify_every=0`` keeps the historical executables byte-for-byte.
+
+    ``preconditioner="mg"`` runs every member with the geometric
+    V-cycle preconditioner (:mod:`poisson_tpu.mg`): the shared member
+    body — V-cycle inside ``apply_Dinv`` — is vmapped exactly like the
+    Jacobi body and the hierarchy canvases broadcast across the batch
+    (one coefficient load for B members). Parity contract: the MG
+    *apply* (one V-cycle) is bit-identical under ``vmap`` and member
+    *i* reproduces ``pcg_solve(..., preconditioner="mg")``'s iteration
+    count and stop flag exactly, with iterates agreeing to a few ULPs —
+    XLA's FMA-contraction choices inside the deep fused cycle+body
+    program differ between the solo and vmapped layouts, which the
+    elementwise Jacobi body never exposed (both pinned by
+    tests/test_mg.py). MG buckets are their own executable family (the
+    bucket-cache key carries the cycle config); mixed per-member
+    ``geometries`` do not co-batch with MG yet — each member would
+    need its own level hierarchy — and are rejected loudly (the solve
+    service dispatches geometry+MG requests solo).
     """
     if mesh is not None:
         raise ValueError(
@@ -433,6 +454,23 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     # it away: batches differing only in RHS magnitude share one compiled
     # executable per bucket.
     jit_problem = problem.with_(f_val=1.0)
+    if preconditioner not in (None, "jacobi"):
+        from poisson_tpu.mg import resolve_preconditioner
+
+        resolve_preconditioner(preconditioner)   # raises on unknown
+        if geometries is not None:
+            if any(g is not None for g in geometries):
+                raise ValueError(
+                    "preconditioner='mg' does not co-batch per-member "
+                    "geometries yet (each member would need its own "
+                    "level hierarchy); dispatch geometry+MG requests "
+                    "solo via pcg_solve(geometry=..., "
+                    "preconditioner='mg')"
+                )
+            geometries = None   # all-None entries: the default domain
+        use_mg = True
+    else:
+        use_mg = False
     geo = setups = None
     if geometries is not None:
         from poisson_tpu.geometry.dsl import parse_geometry
@@ -577,6 +615,26 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
                                     verify_every, v_tol,
                                     stack_pad(0), stack_pad(1),
                                     rhs_stack, stack_pad(3))
+    elif use_mg:
+        from poisson_tpu import obs as _obs
+        from poisson_tpu.mg import DEFAULT_MG, validate_mg_problem
+        from poisson_tpu.mg.hierarchy import device_hierarchy
+        from poisson_tpu.mg.preconditioner import _solve_batched_mg
+
+        cfg = mg_config or DEFAULT_MG
+        validate_mg_problem(problem, cfg)
+        # MG buckets are their own executable family: the cycle config
+        # is operand/static identity exactly like the verify stride.
+        key = (size, jit_problem, dtype_name, use_scaled, ("mg", cfg))
+        if verify_key:
+            key = key + (verify_key,)
+        _count_bucket(key, batch, size)
+        hier = device_hierarchy(problem, dtype_name, use_scaled,
+                                config=cfg)
+        _obs.inc("mg.solves", batch)
+        result = _solve_batched_mg(jit_problem, use_scaled, cfg,
+                                   verify_every, v_tol,
+                                   a, b, rhs_stack, aux, hier)
     else:
         key = (size, jit_problem, dtype_name, use_scaled)
         if verify_key:

@@ -76,7 +76,8 @@ class CorruptCheckpointError(RuntimeError):
     missing payload keys, or CRC mismatch."""
 
 
-def _fingerprint(problem: Problem, dtype_name: str, scaled: bool) -> str:
+def _fingerprint(problem: Problem, dtype_name: str, scaled: bool,
+                 preconditioner: str = "jacobi", mg_config=None) -> str:
     # Bind problem identity, not the stopping budget: max_iter is excluded
     # so a run capped by --max-iter (or preempted) can resume with a larger
     # budget — the natural recovery workflow.
@@ -85,6 +86,18 @@ def _fingerprint(problem: Problem, dtype_name: str, scaled: bool) -> str:
         for f in dataclasses.fields(problem)
         if f.name != "max_iter"
     }
+    if preconditioner not in (None, "jacobi"):
+        # The preconditioner is solve identity: z/p in a persisted state
+        # are M⁻¹-derived, so resuming a Jacobi-written state under MG
+        # (or vice versa) would splice two different Krylov recurrences —
+        # and so would resuming one MGConfig's state under another (the
+        # cycle config IS the M⁻¹), so the config joins the tuple too.
+        # Appended only for non-default preconditioners — historical
+        # Jacobi fingerprints stay byte-identical and keep resuming.
+        from poisson_tpu.mg import DEFAULT_MG
+
+        return repr((sorted(fields.items()), dtype_name, scaled,
+                     preconditioner, mg_config or DEFAULT_MG))
     return repr((sorted(fields.items()), dtype_name, scaled))
 
 
@@ -117,6 +130,57 @@ def _run_chunk(problem: Problem, scaled: bool, chunk: int,
         return (~s.done) & (s.k < stop_at)
 
     return lax.while_loop(cond, body, state)
+
+
+def _chunk_ops_advance(problem: Problem, dtype_name: str, scaled: bool,
+                       a, b, aux, rhs, chunk: int,
+                       stagnation_window: int, stream_every: int,
+                       verify_every: int, verify_tol: float,
+                       preconditioner: str = "jacobi", mg_config=None,
+                       geometry=None):
+    """The (ops, advance, init) triple every chunked driver loops on:
+    the historical Jacobi chunk program, or its MG twin with the level
+    hierarchy bound in (``poisson_tpu.mg``). One seam so the
+    checkpointed, deadline-chunked and resilient paths all route the
+    preconditioner identically. ``init`` builds the fresh start state —
+    JITTED on the MG path (the V-cycle that computes z₀ must run as a
+    compiled program, or eager-vs-compiled rounding costs the
+    chunked-equals-one-shot bit-parity contract; the Jacobi init is
+    elementwise and keeps its historical eager form)."""
+    if preconditioner not in (None, "jacobi"):
+        from poisson_tpu.mg import (
+            DEFAULT_MG,
+            resolve_preconditioner,
+            validate_mg_problem,
+        )
+        from poisson_tpu.mg.hierarchy import device_hierarchy
+        from poisson_tpu.mg.preconditioner import _run_chunk_mg, mg_ops
+
+        resolve_preconditioner(preconditioner)
+        cfg = mg_config or DEFAULT_MG
+        validate_mg_problem(problem, cfg)
+        from poisson_tpu.mg.preconditioner import _member_init_mg
+
+        hier = device_hierarchy(problem, dtype_name, scaled,
+                                geometry=geometry, config=cfg)
+        ops = mg_ops(problem, a, b, aux, hier, cfg, scaled)
+        advance = lambda s: _run_chunk_mg(
+            problem, scaled, chunk, cfg, stagnation_window,
+            int(stream_every), verify_every, verify_tol, a, b, aux,
+            rhs if verify_every else None, hier, s)
+        init = lambda: _member_init_mg(problem, scaled, cfg, a, b, aux,
+                                       hier, rhs)
+        return ops, advance, init
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    advance = lambda s: _run_chunk(
+        problem, scaled, chunk, stagnation_window, int(stream_every),
+        verify_every, verify_tol, a, b, aux,
+        rhs if verify_every else None, s)
+    return ops, advance, (lambda: init_state(ops, rhs))
 
 
 def _state_flag(state) -> Optional[int]:
@@ -461,7 +525,9 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
                            on_chunk=None,
                            deadline=None,
                            verify_every: int = 0,
-                           verify_tol=None) -> PCGResult:
+                           verify_tol=None,
+                           preconditioner: str = "jacobi",
+                           mg_config=None) -> PCGResult:
     """Solve with periodic state persistence and automatic resume.
 
     Every ``chunk`` iterations the CG state is written to
@@ -479,32 +545,39 @@ def pcg_solve_checkpointed(problem: Problem, checkpoint_path: str,
     integrity probe (``poisson_tpu.integrity``); a FLAG_INTEGRITY stop
     is never persisted — the last good generation survives for the
     verified-restart driver (``solvers.resilient``).
+    ``preconditioner="mg"`` chunks the V-cycle-preconditioned solve
+    (:mod:`poisson_tpu.mg`); its checkpoints carry the preconditioner
+    in their fingerprint, so a Jacobi checkpoint never resumes under MG
+    (two different Krylov recurrences) or vice versa.
     """
     if chunk < 1:
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
     a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
-    fp = _fingerprint(problem, dtype_name, use_scaled)
+    fp = _fingerprint(problem, dtype_name, use_scaled, preconditioner,
+                      mg_config)
+    if preconditioner not in (None, "jacobi"):
+        # One driver call = one MG solve (the rollout-fraction counter,
+        # obs.metrics "mg.solves", must cover every dispatch path).
+        from poisson_tpu import obs
+
+        obs.inc("mg.solves")
 
     verify_every = int(verify_every)
     v_tol = (resolve_verify_tol(verify_tol, dtype_name)
              if verify_every > 0 else 0.0)
-    ops = (
-        scaled_single_device_ops(problem, a, b, aux)
-        if use_scaled
-        else single_device_ops(problem, a, b, aux)
-    )
+    ops, advance, init = _chunk_ops_advance(
+        problem, dtype_name, use_scaled, a, b, aux, rhs, chunk,
+        stagnation_window, stream_every, verify_every, v_tol,
+        preconditioner=preconditioner, mg_config=mg_config)
     state = load_state(checkpoint_path, fp, keep_last=keep_last)
     if state is None:
-        state = init_state(ops, rhs)
+        state = init()
 
     state = run_chunked(
         state,
-        advance=lambda s: _run_chunk(problem, use_scaled, chunk,
-                                     stagnation_window, int(stream_every),
-                                     verify_every, v_tol, a, b, aux,
-                                     rhs if verify_every else None, s),
+        advance=advance,
         to_portable=lambda s: s,
         path=checkpoint_path, fingerprint=fp, cap=problem.iteration_cap,
         keep_checkpoint=keep_checkpoint, keep_last=keep_last,
@@ -523,7 +596,9 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
                       stagnation_window: int = 0, stream_every: int = 0,
                       watchdog=None, on_chunk=None,
                       deadline=None, geometry=None,
-                      verify_every: int = 0, verify_tol=None) -> PCGResult:
+                      verify_every: int = 0, verify_tol=None,
+                      preconditioner: str = "jacobi",
+                      mg_config=None) -> PCGResult:
     """Chunked single-device solve WITHOUT persistence: the same
     chunk-boundary loop as :func:`pcg_solve_checkpointed` (watchdog beats,
     fault hooks, deadline awareness) minus the disk. This is the dispatch
@@ -553,20 +628,21 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
                                  geometry=geometry)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
+    if preconditioner not in (None, "jacobi"):
+        from poisson_tpu import obs
+
+        obs.inc("mg.solves")   # one driver call = one MG solve
     verify_every = int(verify_every)
     v_tol = (resolve_verify_tol(verify_tol, dtype_name)
              if verify_every > 0 else 0.0)
-    ops = (
-        scaled_single_device_ops(problem, a, b, aux)
-        if use_scaled
-        else single_device_ops(problem, a, b, aux)
-    )
+    ops, advance, init = _chunk_ops_advance(
+        problem, dtype_name, use_scaled, a, b, aux, rhs, chunk,
+        stagnation_window, stream_every, verify_every, v_tol,
+        preconditioner=preconditioner, mg_config=mg_config,
+        geometry=geometry)
     state = run_chunked(
-        init_state(ops, rhs),
-        advance=lambda s: _run_chunk(problem, use_scaled, chunk,
-                                     stagnation_window, int(stream_every),
-                                     verify_every, v_tol, a, b, aux,
-                                     rhs if verify_every else None, s),
+        init(),
+        advance=advance,
         to_portable=lambda s: s,
         path=None, fingerprint="", cap=problem.iteration_cap,
         keep_checkpoint=False,

@@ -272,11 +272,38 @@ class LaneBatch:
     def __init__(self, problem: Problem, bucket: int, *, dtype=None,
                  scaled=None, chunk: int = 50, on_boundary=None,
                  multi_geometry: bool = False, verify_every: int = 0,
-                 verify_tol=None):
+                 verify_tol=None, preconditioner: str = "jacobi",
+                 mg_config=None):
         if bucket < 1:
             raise ValueError(f"bucket must be >= 1, got {bucket}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # MG lanes (poisson_tpu.mg): the stepping program's member body
+        # carries one V-cycle in apply_Dinv against the SHARED level
+        # hierarchy — decided at construction like multi_geometry (an
+        # occupied program's operand signature never changes). Mixed
+        # per-lane geometries would each need their own hierarchy, so
+        # the combination is rejected (the service dispatches
+        # geometry+MG requests solo).
+        self.preconditioner = "jacobi"
+        self._mg_config = None
+        self._hier = None
+        if preconditioner not in (None, "jacobi"):
+            from poisson_tpu.mg import (
+                DEFAULT_MG,
+                resolve_preconditioner,
+                validate_mg_problem,
+            )
+
+            resolve_preconditioner(preconditioner)
+            if multi_geometry:
+                raise ValueError(
+                    "preconditioner='mg' lanes do not carry per-lane "
+                    "geometries yet; build a jacobi table or dispatch "
+                    "geometry+MG requests solo")
+            self.preconditioner = "mg"
+            self._mg_config = mg_config or DEFAULT_MG
+            validate_mg_problem(problem, self._mg_config)
         # Multi-geometry lanes (poisson_tpu.geometry): the coefficient
         # canvases become PER-LANE stacks spliced alongside the state,
         # so different fictitious domains share the one stepping
@@ -305,11 +332,21 @@ class LaneBatch:
                                     self.use_scaled)
         self._a, self._b, self._aux = a, b, aux
         self._rhs = rhs               # includes problem.f_val
-        self._ops = (
-            scaled_single_device_ops(self._jit_problem, a, b, aux)
-            if self.use_scaled
-            else single_device_ops(self._jit_problem, a, b, aux)
-        )
+        if self.preconditioner == "mg":
+            from poisson_tpu.mg.hierarchy import device_hierarchy
+            from poisson_tpu.mg.preconditioner import mg_ops
+
+            self._hier = device_hierarchy(
+                problem, self.dtype_name, self.use_scaled,
+                config=self._mg_config)
+            self._ops = mg_ops(self._jit_problem, a, b, aux, self._hier,
+                               self._mg_config, self.use_scaled)
+        else:
+            self._ops = (
+                scaled_single_device_ops(self._jit_problem, a, b, aux)
+                if self.use_scaled
+                else single_device_ops(self._jit_problem, a, b, aux)
+            )
         # All lanes start EMPTY: a zero member, pre-stopped, never advanced.
         zeros = jnp.zeros((self.bucket,) + problem.grid_shape,
                           jnp.dtype(self.dtype_name))
@@ -397,8 +434,19 @@ class LaneBatch:
         else:
             ga, gb, grhs, gaux = self._a, self._b, self._rhs, self._aux
         rhs = grhs * jnp.asarray(rhs_gate, grhs.dtype)
-        member = _member_init(self._jit_problem, self.use_scaled,
-                              ga, gb, gaux, rhs)
+        if self.preconditioner == "mg":
+            from poisson_tpu import obs
+            from poisson_tpu.mg.preconditioner import _member_init_mg
+
+            # One splice = one MG-preconditioned member solve (the
+            # lane-engine leg of the mg.solves rollout counter).
+            obs.inc("mg.solves")
+            member = _member_init_mg(self._jit_problem, self.use_scaled,
+                                     self._mg_config, ga, gb, gaux,
+                                     self._hier, rhs)
+        else:
+            member = _member_init(self._jit_problem, self.use_scaled,
+                                  ga, gb, gaux, rhs)
         lane_idx = jnp.asarray(lane, jnp.int32)
         self.state = _set_lane(self.state, lane_idx, member)
         if self.verify_every > 0:
@@ -423,7 +471,16 @@ class LaneBatch:
         active = len(self.active_lanes())
         idle = self.bucket - active
         if active:
-            if self.verify_every > 0 and self.multi_geometry:
+            if self.preconditioner == "mg":
+                from poisson_tpu.mg.preconditioner import _step_lanes_mg
+
+                self.state = _step_lanes_mg(
+                    self._jit_problem, self.use_scaled, self.chunk,
+                    self._mg_config, self.verify_every, self.verify_tol,
+                    self._a, self._b, self._aux, self._hier,
+                    (self._rhs_stack if self.verify_every > 0 else None),
+                    self.state)
+            elif self.verify_every > 0 and self.multi_geometry:
                 self.state = _step_lanes_geo_verify(
                     self._jit_problem, self.use_scaled, self.chunk,
                     self.verify_every, self.verify_tol,

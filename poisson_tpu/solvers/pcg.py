@@ -187,14 +187,28 @@ def init_state(ops: PCGOps, rhs) -> PCGState:
 
 
 def restart_state(ops: PCGOps, rhs, w) -> PCGState:
-    """Fresh CG restart from an existing iterate: r = B − Aw, z = D⁻¹r,
+    """Fresh CG restart from an existing iterate: r = B − Aw, z = M⁻¹r,
     p = z. The recovery driver (``solvers.resilient``) uses this to resume
     from the last good iterate after a divergence — the Krylov history is
-    discarded (it is what went bad), the accumulated solution is kept."""
+    discarded (it is what went bad), the accumulated solution is kept.
+
+    Constructed directly rather than via ``init_state(ops, rhs)``: the
+    init's own ``z = M⁻¹·rhs`` would be computed only to be thrown away
+    by the restart's replacements — harmless when M⁻¹ is the elementwise
+    Jacobi diagonal, a full wasted (and eagerly dispatched) V-cycle when
+    it is the MG preconditioner (``poisson_tpu.mg``)."""
     r = rhs - ops.apply_A(ops.exchange(w))
     z = ops.apply_Dinv(r)
     zr = ops.dot(z, r)
-    return init_state(ops, rhs)._replace(w=w, r=r, z=z, p=z, zr=zr)
+    return PCGState(
+        k=jnp.zeros((), jnp.int32),
+        done=jnp.asarray(False),
+        w=w, r=r, z=z, p=z, zr=zr,
+        diff=jnp.asarray(jnp.inf, rhs.dtype),
+        flag=jnp.asarray(FLAG_NONE, jnp.int32),
+        best=jnp.asarray(jnp.inf, rhs.dtype),
+        stall=jnp.zeros((), jnp.int32),
+    )
 
 
 def make_pcg_member_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
@@ -202,7 +216,8 @@ def make_pcg_member_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
                          stream_every: int = 0, verify_every: int = 0,
                          verify_tol: float = 0.0,
                          verify_jump: Optional[float] = None,
-                         verify_colsum=None):
+                         verify_colsum=None,
+                         preconditioner: str = "jacobi"):
     """The PCG iteration as a ``body(state, rhs) -> state`` pair-form —
     the verification-capable core :func:`make_pcg_body` wraps. The
     second argument is ONLY read when ``verify_every > 0`` (the in-loop
@@ -231,13 +246,17 @@ def make_pcg_member_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
     """
     if verify_every > 0:
         from poisson_tpu.integrity.probe import (
-            DEFAULT_VERIFY_COLLAPSE,
-            DEFAULT_VERIFY_JUMP,
+            default_verify_collapse,
+            default_verify_jump,
         )
 
+        # The update-norm guard ratios are PRECONDITIONER-specific:
+        # MG-preconditioned CG legitimately contracts ‖Δw‖ several-fold
+        # per iteration, so the Jacobi-calibrated ratios would false-
+        # alarm on clean MG solves (measured — see integrity.probe).
         if verify_jump is None:
-            verify_jump = DEFAULT_VERIFY_JUMP
-        verify_collapse = DEFAULT_VERIFY_COLLAPSE
+            verify_jump = default_verify_jump(preconditioner)
+        verify_collapse = default_verify_collapse(preconditioner)
 
     def body(s: PCGState, vrhs=None) -> PCGState:
         p = ops.exchange(s.p)
@@ -371,7 +390,8 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
                   stream_every: int = 0, verify_every: int = 0,
                   verify_tol: float = 0.0,
                   verify_jump: Optional[float] = None,
-                  verify_rhs=None, verify_colsum=None):
+                  verify_rhs=None, verify_colsum=None,
+                  preconditioner: str = "jacobi"):
     """One PCG iteration as a pure state→state function — shared by the
     convergence ``while_loop`` (:func:`pcg_loop`) and the fixed-budget
     diagnostic ``scan`` (``solvers.history``).
@@ -409,6 +429,7 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
         stagnation_window=stagnation_window, stream_every=stream_every,
         verify_every=verify_every, verify_tol=verify_tol,
         verify_jump=verify_jump, verify_colsum=verify_colsum,
+        preconditioner=preconditioner,
     )
     if verify_every == 0:
         return member     # vrhs defaults to None and is never read
@@ -419,7 +440,8 @@ def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
              weighted_norm: bool, h1: float, h2: float,
              stagnation_window: int = 0, stream_every: int = 0,
              verify_every: int = 0, verify_tol: float = 0.0,
-             verify_abft: bool = False) -> PCGState:
+             verify_abft: bool = False,
+             preconditioner: str = "jacobi") -> PCGState:
     """Run the PCG while_loop to convergence; backend-agnostic.
     ``verify_every``/``verify_tol`` arm the in-loop integrity probe
     against this solve's own RHS; ``verify_abft`` additionally traces
@@ -435,7 +457,7 @@ def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
         stagnation_window=stagnation_window, stream_every=stream_every,
         verify_every=verify_every, verify_tol=verify_tol,
         verify_rhs=(rhs if verify_every > 0 else None),
-        verify_colsum=colsum,
+        verify_colsum=colsum, preconditioner=preconditioner,
     )
 
     def cond(s: PCGState):
@@ -635,7 +657,9 @@ def resolve_verify_tol(verify_tol, dtype_name: str) -> float:
 def pcg_solve(problem: Problem, dtype=None, scaled=None,
               rhs_gate=None, stream_every: int = 0,
               geometry=None, verify_every: int = 0,
-              verify_tol=None, verify_abft: bool = False) -> PCGResult:
+              verify_tol=None, verify_abft: bool = False,
+              preconditioner: str = "jacobi",
+              mg_config=None) -> PCGResult:
     """Single-device solve (the stage0/stage1 workload, SURVEY §3.1).
 
     The iteration is jit-compiled end to end; setup runs on the host in fp64
@@ -663,22 +687,56 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
     per check. ``verify_abft`` adds the checksum-row ABFT identity on
     the stencil application. At 0 (the default) no probe is traced:
     byte-identical program, bit-for-bit golden counts.
+
+    ``preconditioner`` selects the M⁻¹ the CG recurrence runs with:
+    ``"jacobi"`` (the default) is the historical diagonal path —
+    byte-identical executables, golden counts bit-for-bit;
+    ``"mg"`` swaps in one geometric V-cycle per iteration
+    (:mod:`poisson_tpu.mg` — near-flat iteration counts in resolution;
+    the grid must coarsen, see ``mg.validate_mg_problem``).
+    ``mg_config`` tunes the cycle (``mg.MGConfig``; None = defaults).
     """
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
+    verify_every = int(verify_every)
+    tol = (resolve_verify_tol(verify_tol, dtype_name)
+           if verify_every > 0 else 0.0)
+    if preconditioner not in (None, "jacobi"):
+        from poisson_tpu import obs
+        from poisson_tpu.mg import (
+            DEFAULT_MG,
+            resolve_preconditioner,
+            validate_mg_problem,
+        )
+        from poisson_tpu.mg.preconditioner import _solve_mg, mg_solve_setup
+
+        resolve_preconditioner(preconditioner)   # raises on unknown
+        cfg = mg_config or DEFAULT_MG
+        validate_mg_problem(problem, cfg)
+        if verify_abft:
+            raise ValueError(
+                "verify_abft is wired for the jacobi path only; drop it "
+                "or use preconditioner='jacobi'"
+            )
+        a, b, rhs, aux, hier = mg_solve_setup(
+            problem, dtype_name, use_scaled, geometry=geometry,
+            config=cfg)
+        if rhs_gate is not None:
+            rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
+        obs.inc("mg.solves")
+        return _solve_mg(problem, use_scaled, cfg, int(stream_every),
+                         verify_every, tol, a, b, rhs, aux, hier)
     a, b, rhs, aux = solve_setup(problem, dtype_name, use_scaled,
                                  geometry=geometry)
     if rhs_gate is not None:
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
-    verify_every = int(verify_every)
-    tol = (resolve_verify_tol(verify_tol, dtype_name)
-           if verify_every > 0 else 0.0)
     return _solve(problem, use_scaled, int(stream_every), verify_every,
                   tol, bool(verify_abft and verify_every > 0),
                   a, b, rhs, aux)
 
 
-def iteration_program(problem: Problem, dtype=None, scaled=None):
+def iteration_program(problem: Problem, dtype=None, scaled=None,
+                      preconditioner: str = "jacobi"):
     """The one-iteration PCG body as a (jittable fn, example state) pair
     — the per-iteration cost-attribution anchor (``obs.costs``).
 
@@ -693,11 +751,27 @@ def iteration_program(problem: Problem, dtype=None, scaled=None):
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
     a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
-    ops = (
-        scaled_single_device_ops(problem, a, b, aux)
-        if use_scaled
-        else single_device_ops(problem, a, b, aux)
-    )
+    if preconditioner not in (None, "jacobi"):
+        # The MG iteration body: the same loop body with one V-cycle in
+        # apply_Dinv — the per-iteration cost anchor the bytes/iter
+        # model for MG cohorts (obs.costs.mg_vcycle_cost) is checked
+        # against.
+        from poisson_tpu.mg import (
+            DEFAULT_MG,
+            device_hierarchy,
+            resolve_preconditioner,
+        )
+        from poisson_tpu.mg.preconditioner import mg_ops
+
+        resolve_preconditioner(preconditioner)
+        hier = device_hierarchy(problem, dtype_name, use_scaled)
+        ops = mg_ops(problem, a, b, aux, hier, DEFAULT_MG, use_scaled)
+    else:
+        ops = (
+            scaled_single_device_ops(problem, a, b, aux)
+            if use_scaled
+            else single_device_ops(problem, a, b, aux)
+        )
     body = make_pcg_body(
         ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
         h1=problem.h1, h2=problem.h2,

@@ -40,7 +40,6 @@ from poisson_tpu import obs
 from poisson_tpu.config import Problem
 from poisson_tpu.solvers.checkpoint import (
     _fingerprint,
-    _run_chunk,
     remove_generations,
     save_state,
 )
@@ -53,14 +52,11 @@ from poisson_tpu.solvers.pcg import (
     FLAG_NONFINITE,
     PCGResult,
     host_setup,
-    init_state,
     iterations_scalar,
     restart_state,
     resolve_dtype,
     resolve_scaled,
     resolve_verify_tol,
-    scaled_single_device_ops,
-    single_device_ops,
 )
 
 # Escalation ladder, low to high. A resilient solve enters at its
@@ -108,18 +104,27 @@ def _rungs_above(dtype_name: str) -> list:
     return rungs
 
 
-def _build(problem: Problem, dtype_name: str, scaled: bool):
+def _build(problem: Problem, dtype_name: str, scaled: bool,
+           chunk: int, stagnation_window: int, stream_every: int,
+           verify_every: int, verify_tol: float,
+           preconditioner: str = "jacobi", mg_config=None):
+    """Fields + ops + chunk advance for one precision rung, routed
+    through the shared preconditioner seam
+    (``checkpoint._chunk_ops_advance``) so MG recovery/escalation
+    rebuilds the hierarchy at the new dtype like every other operand."""
+    from poisson_tpu.solvers.checkpoint import _chunk_ops_advance
+
     a, b, rhs, aux = host_setup(problem, dtype_name, scaled)
-    ops = (
-        scaled_single_device_ops(problem, a, b, aux)
-        if scaled
-        else single_device_ops(problem, a, b, aux)
-    )
-    return a, b, rhs, aux, ops
+    ops, advance, init = _chunk_ops_advance(
+        problem, dtype_name, scaled, a, b, aux, rhs, chunk,
+        stagnation_window, stream_every, verify_every, verify_tol,
+        preconditioner=preconditioner, mg_config=mg_config)
+    return a, b, rhs, aux, ops, advance, init
 
 
 def _load_any_rung(path: str, problem: Problem, dtype_name: str,
-                   scaled: bool, keep_last: int):
+                   scaled: bool, keep_last: int,
+                   preconditioner: str = "jacobi", mg_config=None):
     """Resume across an earlier run's escalation: accept the NEWEST
     loadable generation whose fingerprint matches the requested precision
     or any higher rung (a previous resilient run may have escalated before
@@ -130,7 +135,9 @@ def _load_any_rung(path: str, problem: Problem, dtype_name: str,
 
     rungs = [dtype_name] + _rungs_above(dtype_name)
     found = load_state_any(
-        path, [_fingerprint(problem, dn, scaled) for dn in rungs],
+        path,
+        [_fingerprint(problem, dn, scaled, preconditioner, mg_config)
+         for dn in rungs],
         keep_last,
     )
     if found is None:
@@ -150,7 +157,9 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                         on_chunk=None,
                         deadline=None,
                         verify_every: int = 0,
-                        verify_tol=None) -> PCGResult:
+                        verify_tol=None,
+                        preconditioner: str = "jacobi",
+                        mg_config=None) -> PCGResult:
     """Single-device solve that survives NaN blow-ups, Krylov breakdowns
     and stagnation by restarting from the last good iterate, escalating
     precision when a restart alone does not help.
@@ -191,17 +200,24 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
 
     if checkpoint_path:
         saved, dtype_name = _load_any_rung(
-            checkpoint_path, problem, dtype_name, use_scaled, keep_last
+            checkpoint_path, problem, dtype_name, use_scaled, keep_last,
+            preconditioner, mg_config,
         )
     else:
         saved = None
 
-    a, b, rhs, aux, ops = _build(problem, dtype_name, use_scaled)
-    state = saved if saved is not None else init_state(ops, rhs)
-
     verify_every = int(verify_every)
     v_tol = (resolve_verify_tol(verify_tol, dtype_name)
              if verify_every > 0 else 0.0)
+    if preconditioner not in (None, "jacobi"):
+        obs.inc("mg.solves")   # entry only — escalation rebuilds are
+        #                        the SAME solve, not a new dispatch
+    a, b, rhs, aux, ops, advance, init = _build(
+        problem, dtype_name, use_scaled, chunk,
+        policy.stagnation_window, stream_every, verify_every, v_tol,
+        preconditioner=preconditioner, mg_config=mg_config)
+    state = saved if saved is not None else init()
+
     cap = problem.iteration_cap
     restarts = 0
     restarts_at_dtype = 0
@@ -215,7 +231,8 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
     # The entry state is trivially verified: r = b − Aw by
     # construction at init, CRC-sealed on a resume.
     last_verified = (state.w, int(state.k))
-    fp = _fingerprint(problem, dtype_name, use_scaled)
+    fp = _fingerprint(problem, dtype_name, use_scaled, preconditioner,
+                      mg_config)
     chunks_done = 0
 
     def diagnostics(flag: int) -> dict:
@@ -246,10 +263,7 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                 obs.event("resilient.deadline_stop", iteration=int(state.k),
                           restarts=restarts, chunks=chunks_done)
                 break
-            state = _run_chunk(problem, use_scaled, chunk,
-                               policy.stagnation_window, int(stream_every),
-                               verify_every, v_tol, a, b, aux,
-                               rhs if verify_every else None, state)
+            state = advance(state)
             jax.block_until_ready(state)
             chunks_done += 1
             if watchdog is not None:
@@ -329,7 +343,8 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
 
                 jump_stop = (_math.isfinite(float(state.best))
                              and float(state.best)
-                             > _iprobe.DEFAULT_VERIFY_COLLAPSE / 2
+                             > _iprobe.default_verify_collapse(
+                                 preconditioner or "jacobi") / 2
                              * float(state.diff))
                 if not drifted and not jump_stop:
                     obs.inc("integrity.false_alarms")
@@ -398,13 +413,18 @@ def pcg_solve_resilient(problem: Problem, dtype=None, scaled=None,
                 rungs = _rungs_above(dtype_name)
                 if rungs:
                     dtype_name = rungs[0]
-                    a, b, rhs, aux, ops = _build(
-                        problem, dtype_name, use_scaled
-                    )
-                    fp = _fingerprint(problem, dtype_name, use_scaled)
                     if verify_every > 0:
                         # The drift floor moved with the precision.
                         v_tol = resolve_verify_tol(verify_tol, dtype_name)
+                    a, b, rhs, aux, ops, advance, init = _build(
+                        problem, dtype_name, use_scaled, chunk,
+                        policy.stagnation_window, stream_every,
+                        verify_every, v_tol,
+                        preconditioner=preconditioner,
+                        mg_config=mg_config,
+                    )
+                    fp = _fingerprint(problem, dtype_name, use_scaled,
+                                      preconditioner, mg_config)
                     restarts_at_dtype = 0
                     escalated = True
             action = (f"escalate->{dtype_name}" if escalated

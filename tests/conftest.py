@@ -103,6 +103,16 @@ def pytest_configure(config):
         "scenarios, sentinel cohort pins; CPU-fast; runs in tier-1, "
         "selectable with -m integrity)",
     )
+    config.addinivalue_line(
+        "markers",
+        "mg: geometric-multigrid preconditioning suite "
+        "(default-jacobi-path HLO/golden pins, two-grid convergence "
+        "factor, V-cycle apply bit-parity under vmap, per-family "
+        "manufactured L2 floors, batched/lane/chunked parity, "
+        "iteration ~flatness across resolutions, serve cohort split, "
+        "sentinel cohort/direction pins; CPU-fast; runs in tier-1, "
+        "selectable with -m mg)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
