@@ -28,15 +28,22 @@ with both engines' p50/p99 and the drain arm's sustained rate in the
 detail (``continuous_beats_drain`` is the at-equal-p99 verdict), cohorted
 by ``arrival_rate`` + ``fault_load`` so rates are never cross-judged.
 
-Fleet mode (``--serve R --workers W [--kill-worker-at T]
-[--arrival-rate L]``) runs the open-loop generator across a W-worker
-supervised fleet (``serve.fleet``) and reports sustained solves/sec
-under worker churn: ``--kill-worker-at T`` crashes a worker mid-run, the
-supervisor recovers its in-flight requests onto the survivors, and the
-run fails unless every admitted request completed with exactly one typed
-outcome. ``detail.workers`` + the churn fault mix join the regression
-sentinel's cohort key — a churned fleet number never judges a
-single-worker clean baseline.
+Fleet mode (``--serve R --workers W [--devices D] [--kill-worker-at T]
+[--kill-device-at T] [--arrival-rate L]``) runs the open-loop generator
+across a W-worker supervised fleet (``serve.fleet``) and reports
+sustained solves/sec under worker AND device churn: ``--devices D``
+binds the workers to D fault-domain slots (``serve.placement``; CPU
+gets real topologies via
+``XLA_FLAGS=--xla_force_host_platform_device_count``),
+``--kill-worker-at T`` crashes a worker mid-run, ``--kill-device-at T``
+kills a whole DEVICE — the supervisor quarantines the fault domain,
+recovers its in-flight requests onto surviving devices, and rebinds the
+workers at restart — and the run fails unless every admitted request
+completed with exactly one typed outcome. ``detail.workers`` +
+``detail.devices``/``device_topology`` + the churn fault mix join the
+regression sentinel's cohort key with direction pins — a churned or
+multi-device fleet number never judges a single-worker, single-device
+clean baseline.
 
 All modes honor ``POISSON_TPU_COMPILE_CACHE=<dir>`` (the persistent JAX
 compilation cache; hits/misses are counted in the metrics snapshot).
@@ -394,7 +401,7 @@ def _batched_bench(problem, batch: int, devices, platform: str,
 
 def _warm_serve_buckets(problem, dtype, max_batch: int, requests: int,
                         refill_chunk=None, exact_sizes=(),
-                        geometry=None) -> list:
+                        geometry=None, devices=()) -> list:
     """Compile every bucket executable a serve-mode schedule can touch.
 
     The old warm-up ran one full campaign, which only reliably warms the
@@ -414,8 +421,14 @@ def _warm_serve_buckets(problem, dtype, max_batch: int, requests: int,
     ``geometry`` warms the STACKED-canvas executable family instead
     (the ``…:geo`` cohort's programs — ``--geometry-mix`` mode): one
     spec suffices, since every geometry mix of a bucket shares the one
-    executable.
+    executable. ``devices`` warms the ladder ON each listed
+    ``jax.Device`` (the fleet's bound devices — ``--devices`` mode):
+    an executable compiled implicitly on the default device would hand
+    every other worker's first dispatch a cross-device transfer plus a
+    recompile, exactly the spike the warm-up exists to absorb.
     """
+    import jax
+
     from poisson_tpu.solvers.batched import bucket_size, solve_batched
     from poisson_tpu.utils.timing import fence
 
@@ -426,23 +439,40 @@ def _warm_serve_buckets(problem, dtype, max_batch: int, requests: int,
         b *= 2
     ladder = sorted(set(ladder) | {int(s) for s in exact_sizes
                                    if 1 <= int(s) <= max_batch})
-    for b in ladder:
-        fence(solve_batched(problem, rhs_gates=[0.0] * b, dtype=dtype,
-                            bucket=b,
-                            geometries=(None if geometry is None
-                                        else [geometry] * b)
-                            ).iterations)
-        if refill_chunk is not None:
-            from poisson_tpu.solvers.lanes import LaneBatch
+    import contextlib
 
-            # One splice → step → retire cycle per bucket warms the lane
-            # stepping program AND the traced-index splice/retire helpers
-            # (each is compiled per bucket width).
-            lanes = LaneBatch(problem, b, dtype=dtype, chunk=refill_chunk,
-                              multi_geometry=geometry is not None)
-            lanes.splice("warmup", 0.0, geometry=geometry)
-            lanes.step()
-            lanes.retire(0)
+    # Each DISTINCT physical device compiles its own ladder (duplicate
+    # entries — an oversubscribed topology — warm once).
+    targets, seen = [], set()
+    for dev in (devices or (None,)):
+        key = id(dev) if dev is not None else None
+        if key not in seen:
+            seen.add(key)
+            targets.append(dev)
+    for dev in targets:
+        ctx = (jax.default_device(dev) if dev is not None
+               else contextlib.nullcontext())
+        with ctx:
+            for b in ladder:
+                fence(solve_batched(problem, rhs_gates=[0.0] * b,
+                                    dtype=dtype, bucket=b,
+                                    geometries=(None if geometry is None
+                                                else [geometry] * b)
+                                    ).iterations)
+                if refill_chunk is not None:
+                    from poisson_tpu.solvers.lanes import LaneBatch
+
+                    # One splice → step → retire cycle per bucket warms
+                    # the lane stepping program AND the traced-index
+                    # splice/retire helpers (each is compiled per
+                    # bucket width).
+                    lanes = LaneBatch(problem, b, dtype=dtype,
+                                      chunk=refill_chunk,
+                                      multi_geometry=geometry is not None,
+                                      device=dev)
+                    lanes.splice("warmup", 0.0, geometry=geometry)
+                    lanes.step()
+                    lanes.retire(0)
     return ladder
 
 
@@ -774,21 +804,30 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
 
 def _serve_fleet_bench(problem, requests: int, workers: int,
                        kill_at, rate, devices, platform: str,
-                       downgraded: bool = False) -> int:
-    """Fleet mode (``--serve R --workers W [--kill-worker-at T]``):
-    sustained solves/sec under worker churn. An open-loop Poisson
-    arrival schedule drives the continuous engine across a W-worker
-    fleet (``serve.fleet``); ``--kill-worker-at T`` injects a worker
-    crash at T seconds — the supervisor quarantines it, recovers its
-    in-flight requests onto the survivors, and restarts it through
-    warm-up, all while the generator keeps submitting. The record is
-    the surviving fleet's sustained throughput, and the run FAILS
-    (exit 1) unless every admitted request completed with exactly one
-    typed outcome — churn must never cost a request its outcome.
+                       downgraded: bool = False, fleet_devices=None,
+                       kill_device_at=None) -> int:
+    """Fleet mode (``--serve R --workers W [--devices D]
+    [--kill-worker-at T] [--kill-device-at T]``): sustained solves/sec
+    under worker and DEVICE churn. An open-loop Poisson arrival
+    schedule drives the continuous engine across a W-worker fleet
+    (``serve.fleet``); ``--devices D`` binds the workers round-robin to
+    D fault-domain slots (``serve.placement`` — CPU gets real
+    multi-device topologies via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count``);
+    ``--kill-worker-at T`` injects a worker crash at T seconds, and
+    ``--kill-device-at T`` a DEVICE loss — the supervisor quarantines
+    the whole fault domain, recovers its in-flight requests onto
+    surviving devices, and rebinds the workers at restart, all while
+    the generator keeps submitting. The record is the surviving
+    fleet's sustained throughput, and the run FAILS (exit 1) unless
+    every admitted request completed with exactly one typed outcome —
+    churn must never cost a request its outcome.
 
-    ``detail.workers`` and the churn fault mix join the regression
-    sentinel's cohort key (``benchmarks/regress.py``): a W-worker
-    number never judges a single-worker baseline.
+    ``detail.workers``, ``detail.devices``/``device_topology`` and the
+    churn fault mix join the regression sentinel's cohort key
+    (``benchmarks/regress.py``) with direction pins: a W-worker or
+    D-device number never judges a single-worker, single-device
+    baseline.
     """
     from poisson_tpu import obs
     from poisson_tpu.obs import metrics as obs_metrics
@@ -800,11 +839,18 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
         ServicePolicy,
         SolveService,
     )
+    from poisson_tpu.testing.faults import kill_device_at as device_churn
     from poisson_tpu.testing.faults import kill_worker_at as churn_fault
 
     rate = rate or 50.0
     max_batch = 4
     refill_chunk = 50
+    if fleet_devices is not None and fleet_devices > len(devices):
+        print(f"bench: --devices {fleet_devices} > {len(devices)} "
+              "physical device(s); fault-domain slots will "
+              "oversubscribe (set XLA_FLAGS="
+              "--xla_force_host_platform_device_count for real CPU "
+              "topologies)", file=sys.stderr)
     quiet = DegradationPolicy(shrink_padding_at=9.0,
                               cap_iterations_at=9.0,
                               downshift_precision_at=9.0)
@@ -815,24 +861,41 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
         retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
                           backoff_cap=0.1),
         fleet=FleetPolicy(workers=workers, quarantine_seconds=0.2,
-                          recovery_backoff=0.02),
+                          recovery_backoff=0.02,
+                          devices=fleet_devices),
     )
     schedule = _poisson_schedule(requests, rate)
 
+    warm_devices = ()
+    if fleet_devices is not None:
+        # Warm the bucket ladder ON each bound device — a restarted or
+        # multi-device fleet must not pay cross-device transfers plus
+        # recompiles out of its first real dispatches.
+        warm_devices = tuple(devices[i % len(devices)]
+                             for i in range(fleet_devices))
     with obs.span("bench.serve_warmup", fence=False, requests=requests):
         t0 = time.time()
         warmed = _warm_serve_buckets(problem, "float32", max_batch,
-                                     requests, refill_chunk=refill_chunk)
+                                     requests, refill_chunk=refill_chunk,
+                                     devices=warm_devices)
         warm_seconds = time.time() - t0
     obs.inc("time.compile_seconds", warm_seconds)
 
     # The churn clock starts before service construction so a
     # --kill-worker-at 0 fires on the very first dispatch.
     t_bench = time.perf_counter()
-    worker_fault = None
-    if kill_at is not None:
-        worker_fault = churn_fault(
-            kill_at, lambda: time.perf_counter() - t_bench)
+    bench_clock = lambda: time.perf_counter() - t_bench
+    wk_fault = (churn_fault(kill_at, bench_clock)
+                if kill_at is not None else None)
+    device_fault = (device_churn(kill_device_at, bench_clock)
+                    if kill_device_at is not None else None)
+    injectors = [f for f in (device_fault, wk_fault) if f is not None]
+    if len(injectors) > 1:
+        from poisson_tpu.testing.faults import compose_faults
+
+        worker_fault = compose_faults(*injectors)
+    else:
+        worker_fault = injectors[0] if injectors else None
     svc = SolveService(policy, seed=0, worker_fault=worker_fault)
     with obs.span("bench.serve_fleet", fence=False, requests=requests,
                   workers=workers):
@@ -848,13 +911,24 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
     # experiment and must cohort as one — regress.py keys on
     # fault_load, and clean-speed values in the churn cohort would
     # poison its baseline.
-    kill_fired = (worker_fault is not None
-                  and worker_fault.state["kills"] > 0)
+    kill_fired = (wk_fault is not None
+                  and wk_fault.state["kills"] > 0)
+    device_loss_fired = (device_fault is not None
+                         and device_fault.state["losses"] > 0)
     if kill_at is not None and not kill_fired:
         print(f"bench: --kill-worker-at {kill_at:g} never fired "
               f"(makespan {makespan:.3f}s); recording fault_load=clean",
               file=sys.stderr)
-    fault_load = f"kill_worker@{kill_at:g}" if kill_fired else "clean"
+    if kill_device_at is not None and not device_loss_fired:
+        print(f"bench: --kill-device-at {kill_device_at:g} never fired "
+              f"(makespan {makespan:.3f}s); recording fault_load=clean",
+              file=sys.stderr)
+    loads = []
+    if kill_fired:
+        loads.append(f"kill_worker@{kill_at:g}")
+    if device_loss_fired:
+        loads.append(f"kill_device@{kill_device_at:g}")
+    fault_load = "+".join(loads) if loads else "clean"
     record = {
         "metric": "serve.sustained_solves_per_sec",
         "value": round(sustained, 3),
@@ -867,6 +941,8 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
             "workers": workers,
             "kill_worker_at": kill_at,
             "kill_fired": kill_fired,
+            "kill_device_at": kill_device_at,
+            "device_loss_fired": device_loss_fired,
             "completed": stats["completed"],
             "errors": stats["errors"],
             "shed": stats["shed"],
@@ -879,6 +955,10 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
             "restarts": obs_metrics.get("serve.fleet.restarts"),
             "recovered_requests": obs_metrics.get(
                 "serve.fleet.recovered_requests"),
+            "device_losses": obs_metrics.get(
+                "serve.fleet.device_losses"),
+            "placement_rebinds": obs_metrics.get(
+                "serve.placement.rebinds"),
             "sticky_hits": obs_metrics.get("serve.fleet.sticky_hits"),
             "p99_exemplar": _serve_p99_exemplar(svc),
             "slowest_requests": _serve_slowest(svc),
@@ -886,13 +966,28 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
             "warmup_seconds": round(warm_seconds, 2),
             "dtype": "float32",
             "backend": "xla_serve",
-            "devices": 1,
+            # The fleet's fault-domain count is experiment identity:
+            # regress.py's cohort key carries it (plus the topology
+            # string below), so a D-device run never judges a
+            # single-device baseline.
+            "devices": fleet_devices if fleet_devices is not None else 1,
             "platform": platform,
             "device_kind": getattr(devices[0], "device_kind", None),
+            # Topology detail ONLY for --devices runs: a plain fleet
+            # record must keep cohorting with its historical baselines
+            # (device_topology=None matches pre-placement records).
+            "device_topology": (
+                "{}x{}".format(stats["placement"]["devices"],
+                               "+".join(stats["placement"]["kinds"])
+                               or platform)
+                if fleet_devices is not None else None),
+            "placement": (stats["placement"]
+                          if fleet_devices is not None else None),
             "platform_fallback": downgraded,
             # Cohort discriminators for benchmarks/regress.py: worker
-            # count and churn mix are experiment identity — a 4-worker
-            # churn number never judges a single-worker clean baseline.
+            # count, device topology and churn mix are experiment
+            # identity — a 4-worker churn number never judges a
+            # single-worker clean baseline.
             "fault_load": fault_load,
         },
     }
@@ -900,7 +995,7 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
     obs.event("bench.serve_fleet", **{
         k: v for k, v in record["detail"].items()
         if k not in ("p99_exemplar", "slowest_requests",
-                     "warmed_buckets")},
+                     "warmed_buckets", "placement")},
         sustained_solves_per_sec=record["value"])
     obs.finalize()
     print(json.dumps(record))
@@ -1406,6 +1501,45 @@ def main() -> int:
             print(f"--workers must be >= 1, got {serve_workers}",
                   file=sys.stderr)
             return 2
+    fleet_devices = None
+    if "--devices" in argv:
+        i = argv.index("--devices")
+        try:
+            fleet_devices = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python bench.py --serve R --workers W "
+                  "--devices D [--kill-device-at T] [M N]",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if serve_workers is None:
+            print("--devices is a --serve --workers mode option",
+                  file=sys.stderr)
+            return 2
+        if fleet_devices < 1:
+            print(f"--devices must be >= 1, got {fleet_devices}",
+                  file=sys.stderr)
+            return 2
+    kill_device_at = None
+    if "--kill-device-at" in argv:
+        i = argv.index("--kill-device-at")
+        try:
+            kill_device_at = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python bench.py --serve R --workers W "
+                  "--devices D --kill-device-at T [M N]",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if fleet_devices is None or fleet_devices < 2:
+            print("--kill-device-at needs --serve --workers --devices D "
+                  "with D >= 2 (losing the only device is a total "
+                  "outage, not a churn experiment)", file=sys.stderr)
+            return 2
+        if kill_device_at < 0:
+            print(f"--kill-device-at must be >= 0, got {kill_device_at}",
+                  file=sys.stderr)
+            return 2
     kill_worker_at = None
     if "--kill-worker-at" in argv:
         i = argv.index("--kill-worker-at")
@@ -1523,7 +1657,9 @@ def main() -> int:
             return _serve_fleet_bench(problem, serve_requests,
                                       serve_workers, kill_worker_at,
                                       arrival_rate, devices, platform,
-                                      downgraded=downgraded)
+                                      downgraded=downgraded,
+                                      fleet_devices=fleet_devices,
+                                      kill_device_at=kill_device_at)
         if arrival_rate is not None:
             return _serve_openloop_bench(problem, serve_requests,
                                          arrival_rate, devices, platform,

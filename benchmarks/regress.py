@@ -55,7 +55,13 @@ tests/test_integrity.py). Preconditioner records (``bench.py
 key: an MG-preconditioned iteration deliberately trades per-iteration
 bytes for a near-flat iteration count, so its MLUPS are a different
 experiment — MG runs never judge Jacobi baselines, and vice versa
-(pinned by tests/test_mg.py).
+(pinned by tests/test_mg.py). Placement records (``bench.py --serve
+--workers W --devices D [--kill-device-at T]``) carry
+``detail.device_topology`` (beside ``devices``) in the cohort key with
+the metric's own direction pins: throughput spread over D fault-domain
+slots — or measured through a device loss (``fault_load``
+``kill_device@T``) — never judges a single-device clean baseline
+(pinned by tests/test_placement.py).
 
 Stdlib only, no jax import: like the forensics renderer, a post-session
 gate must never risk initializing a backend.
@@ -104,6 +110,7 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                geometry_mix: Optional[int] = None,
                verify_every: Optional[int] = None,
                preconditioner: Optional[str] = None,
+               device_topology: Optional[str] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -144,6 +151,13 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # (V-cycle traffic), so its MLUPS live in their own cohort: MG
         # runs never judge Jacobi baselines, and vice versa. Cohort key.
         "preconditioner": preconditioner,
+        # Fleet device topology (bench.py --serve --workers --devices):
+        # the fault-domain count and device kinds are experiment
+        # identity — throughput spread over D devices never judges a
+        # single-device baseline, and the direction pins stay the
+        # metric's own (sustained solves/sec alarms on a DROP, p99 on a
+        # RISE, regardless of topology). Cohort key.
+        "device_topology": device_topology,
         "failed": bool(failed),
         "note": note,
     }
@@ -181,6 +195,7 @@ def record_from_result(result: dict, source: str,
         geometry_mix=det.get("geometry_mix"),
         verify_every=det.get("verify_every"),
         preconditioner=det.get("preconditioner"),
+        device_topology=det.get("device_topology"),
     )
 
 
@@ -283,7 +298,7 @@ def cohort_key(rec: dict):
             rec.get("devices"), rec.get("fault_load"),
             rec.get("arrival_rate"), rec.get("workers"),
             rec.get("geometry_mix"), rec.get("verify_every"),
-            rec.get("preconditioner"))
+            rec.get("preconditioner"), rec.get("device_topology"))
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

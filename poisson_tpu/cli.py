@@ -779,6 +779,17 @@ def build_batched_parser() -> argparse.ArgumentParser:
                    help="give each member a distinct RHS magnitude "
                         "(gate 1+i/B) so members converge at different "
                         "iterations and the per-member masking is visible")
+    p.add_argument("--mesh", type=_parse_mesh, default=None,
+                   metavar="PXxPY",
+                   help="run the whole bucket as ONE sharded dispatch "
+                        "on a PXxPY device mesh (batch×mesh "
+                        "composition: vmap outside shard_map — members "
+                        "stay whole-grid, the mesh splits the grid, "
+                        "halo traffic amortizes over the batch; "
+                        "per-member counts/flags reproduce the "
+                        "single-device driver; CPU gets real meshes "
+                        "via XLA_FLAGS="
+                        "--xla_force_host_platform_device_count)")
     p.add_argument("--geometry", metavar="SPEC", action="append",
                    default=None,
                    help="geometry-DSL JSON (inline or @file.json); "
@@ -880,12 +891,27 @@ def _main_solve_batched(argv) -> int:
             validate_mg_problem(problem)
         except ValueError as e:
             raise SystemExit(f"--preconditioner mg: {e}")
+    mesh = None
+    if args.mesh is not None:
+        import jax
+
+        from poisson_tpu.parallel.mesh import make_solver_mesh
+
+        px, py = args.mesh
+        devices = jax.devices()
+        if px * py > len(devices):
+            raise SystemExit(
+                f"--mesh {px}x{py} needs {px * py} devices, found "
+                f"{len(devices)} (CPU: set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={px * py})")
+        mesh = make_solver_mesh(devices[: px * py], grid=(px, py))
     run = lambda: solve_batched(problem, rhs_gates=gates,
                                 dtype=args.dtype, bucket=args.bucket,
                                 geometries=geometries,
                                 verify_every=args.verify_every,
                                 verify_tol=args.verify_tol,
-                                preconditioner=args.preconditioner)
+                                preconditioner=args.preconditioner,
+                                mesh=mesh)
     timer = PhaseTimer()
     with timer.phase("compile_and_first_solve"):
         result = run()
@@ -1031,6 +1057,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "classic single-worker service). Each worker "
                         "owns sticky bucket executables, its own "
                         "breaker cohort, and a heartbeat watchdog")
+    p.add_argument("--devices", type=int, default=None, metavar="D",
+                   help="bind the fleet's workers round-robin to D "
+                        "device fault-domain slots (serve.placement): "
+                        "sticky executables compile ON the bound "
+                        "device, breaker/integrity cohorts key on it, "
+                        "and a device loss quarantines the whole "
+                        "domain (default: one slot on the process "
+                        "default device — the pre-placement fleet). "
+                        "CPU gets real topologies via XLA_FLAGS="
+                        "--xla_force_host_platform_device_count")
     p.add_argument("--journal", metavar="PATH", default=None,
                    help="write-ahead request journal (serve.journal): "
                         "every lifecycle transition is CRC-sealed and "
@@ -1158,7 +1194,7 @@ def _main_serve(argv) -> int:
         scheduling=(SCHED_CONTINUOUS if args.continuous
                     else SCHED_DRAIN),
         refill_chunk=args.refill_chunk,
-        fleet=FleetPolicy(workers=args.workers),
+        fleet=FleetPolicy(workers=args.workers, devices=args.devices),
         integrity=IntegrityPolicy(verify_every=args.verify_every,
                                   verify_tol=args.verify_tol),
         preconditioner=args.preconditioner,

@@ -78,6 +78,8 @@ POINT_CHUNK = "chunk_step"
 POINT_DEADLINE = "deadline"
 POINT_RECOVERED = "recovered"      # re-enqueued off a dead worker/journal
 POINT_QUARANTINE = "quarantine"    # the worker serving this request fell
+POINT_PLACEMENT = "placement_remapped"  # recovered onto a different device
+#                                    (topology changed under the journal)
 
 _ROOT_SPAN_ID = 0
 

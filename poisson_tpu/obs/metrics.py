@@ -112,7 +112,32 @@ Naming convention (dotted, low cardinality):
   ``serve.fleet.recovered_requests`` (in-flight requests pulled off a
   fallen worker and re-dispatched to survivors with mutual taint) /
   ``serve.fleet.sticky_{hits,misses}`` (routing that found/missed a
-  worker already holding the queue head's bucket executable);
+  worker already holding the queue head's bucket executable) /
+  ``serve.fleet.device_losses`` (DEVICE fault domains marked lost —
+  counted per device, not per worker or per dispatch: a
+  ``DeviceLossError`` quarantines every worker bound to the device,
+  bumps the placement epoch, and all of that is ONE loss; read next to
+  ``serve.fleet.quarantines`` to tell "a worker fell" from "the
+  silicon under N workers vanished");
+- ``serve.placement.*`` — the device placement registry
+  (``serve.placement``, ``FleetPolicy.devices``):
+  ``serve.placement.binds`` (worker→device bindings handed out) /
+  ``serve.placement.rebinds`` (quarantined workers rebound to a
+  SURVIVING device at restart — the topology-aware half of a fleet
+  restart; their sticky executables recompile on the new device
+  through the ordinary warm-up) / ``serve.placement.remapped``
+  (journal-recovered requests whose recorded device no longer exists
+  on this topology, remapped AUDIBLY to a survivor — each also
+  carries a ``placement_remapped`` flight point; silence here while
+  ``serve.recovered`` moves after a topology change means work is
+  resuming onto ghost device ids, the exact failure this counter
+  exists to rule out) / ``serve.placement.replans`` (elastic
+  re-plans of sharded dispatches onto the surviving topology; the
+  ladder rungs land on ``serve.degraded.mesh_shrink`` /
+  ``.single_device`` / ``.mesh_shed``, counted like the queue-depth
+  ladder) / gauges ``serve.placement.devices`` / ``.alive`` /
+  ``.epoch`` (the placement epoch — bumped on every loss, carried by
+  journal records so recovery can see the topology changed);
 - ``serve.journal.*`` — the crash-safe write-ahead journal
   (``serve.journal``): ``serve.journal.records`` (CRC-sealed lifecycle
   transitions appended) / ``serve.journal.write_errors`` (appends the

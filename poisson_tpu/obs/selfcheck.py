@@ -35,6 +35,12 @@ clean registry) proves the silent-data-corruption defense
 and the golden iteration count; a seeded exponent bit-flip mid-solve →
 detection → verified restart → convergence with zero false alarms; the
 ``integrity_*`` and ``serve_integrity_*`` counters survive exposition.
+Step 18 (runs LAST of all, clean registry) proves device placement &
+fault domains (``serve.placement``): a device-loss drill — the fault
+domain quarantined whole, in-flight work recovered onto the surviving
+device, the worker rebound at restart — with the
+``serve_fleet_device_losses``/``serve_placement_*`` counters surviving
+Prometheus exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -570,6 +576,64 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in mg_parsed:
             return _fail(f"exposition lost the {prom_name} metric")
 
+    # 18. Device placement & fault domains (runs LAST of all, clean
+    # registry): a two-worker fleet bound to two device slots takes a
+    # DEVICE loss mid-dispatch — the fault domain is quarantined whole,
+    # the in-flight requests recover onto the surviving device, the
+    # worker rebinds at restart — and the
+    # serve_fleet_device_losses/serve_placement_* counters survive the
+    # Prometheus exposition round trip.
+    from poisson_tpu.serve import FleetPolicy as _FleetPolicy
+    from poisson_tpu.serve import RetryPolicy as _RetryPolicy
+    from poisson_tpu.testing.faults import device_loss_fault
+
+    obs_metrics.reset()
+    vc18 = VirtualClock()
+    holder18 = {}
+    svc18 = SolveService(
+        ServicePolicy(
+            capacity=16, max_batch=4,
+            retry=_RetryPolicy(max_attempts=3, backoff_base=0.02,
+                               backoff_cap=0.1),
+            fleet=_FleetPolicy(workers=2, devices=2,
+                               quarantine_seconds=0.02,
+                               recovery_backoff=0.02),
+        ),
+        clock=vc18, sleep=vc18.sleep, seed=0,
+        worker_fault=device_loss_fault(
+            {0}, lambda wid: holder18["svc"].worker_device(wid)),
+    )
+    holder18["svc"] = svc18
+    for i in range(4):
+        svc18.submit(SolveRequest(request_id=f"dev-{i}", problem=problem,
+                                  rhs_gate=1.0 + i / 10))
+    place_outs = svc18.drain()
+    place_stats = svc18.stats()
+    if place_stats["lost"] != 0 or not all(o.converged
+                                          for o in place_outs):
+        return _fail(f"device-loss drill lost requests: {place_stats}")
+    # Rebinding happens at restart — release the quarantine (the drain
+    # can finish on the survivor before the cooldown does) and pump
+    # the restart through.
+    vc18.advance(1.0)
+    svc18.pump()
+    place_stats = svc18.stats()
+    device_losses = obs_metrics.get("serve.fleet.device_losses")
+    rebinds = obs_metrics.get("serve.placement.rebinds")
+    if device_losses != 1 or rebinds < 1:
+        return _fail(f"placement counters missed the device loss: "
+                     f"device_losses={device_losses}, rebinds={rebinds}")
+    if place_stats["placement"]["lost"] != [0] \
+            or place_stats["placement"]["epoch"] != 2:
+        return _fail(f"registry did not record the loss: "
+                     f"{place_stats['placement']}")
+    place_parsed = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_serve_fleet_device_losses",
+                      "poisson_tpu_serve_placement_rebinds",
+                      "poisson_tpu_serve_placement_epoch"):
+        if prom_name not in place_parsed:
+            return _fail(f"exposition lost the {prom_name} metric")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -586,7 +650,8 @@ def run_selfcheck(out_dir: str) -> int:
           f"({int(detections)} detection -> {int(vrestarts)} verified "
           f"restart, 0 false alarms, sdc-verified-restart green), "
           f"multigrid ok ({', '.join(f'{g}: {j}->{m} it' for g, (j, m) in mg_iters.items())}, "
-          f"hierarchy cache hit) "
+          f"hierarchy cache hit), placement ok ({int(device_losses)} "
+          f"device loss -> {int(rebinds)} rebind, 0 lost) "
           f"({out_dir})")
     return 0
 

@@ -60,6 +60,8 @@ def initialize_multihost(coordinator: Optional[str] = None,
                          process_id: Optional[int] = None,
                          max_retries: int = 3,
                          backoff_seconds: float = 1.0,
+                         jitter: float = 0.5,
+                         seed: Optional[int] = None,
                          sleep=time.sleep) -> int:
     """Join (or form) the distributed runtime; returns this process's index.
 
@@ -75,7 +77,14 @@ def initialize_multihost(coordinator: Optional[str] = None,
     Transient failures (coordinator not yet listening, connection timeout —
     normal during a racing pod launch or a recovery restart) are retried
     ``max_retries`` times with exponential backoff starting at
-    ``backoff_seconds``. When the retries are exhausted: an explicitly
+    ``backoff_seconds``, jittered over ``[1 − jitter, 1]`` by a SEEDED
+    RNG (``seed``; default: this process's ``process_id``, else its
+    pid) — a whole fleet of hosts retrying a dead coordinator after a
+    host drop would otherwise thunder back in lockstep at exactly 1 s,
+    2 s, 4 s, re-creating the very connection storm the backoff exists
+    to drain. Per-process seeding decorrelates the herd while keeping
+    each host's retry schedule reproducible. When the retries are
+    exhausted: an explicitly
     requested cluster (``coordinator`` given) raises — the caller asked for
     a specific world and silently not getting it would corrupt the run —
     while an env-driven init degrades gracefully to a single-host run with
@@ -84,6 +93,12 @@ def initialize_multihost(coordinator: Optional[str] = None,
     global _initialized
     if _initialized:
         return jax.process_index()  # documented no-op on a second call
+    import os
+    import random
+
+    if seed is None:
+        seed = process_id if process_id is not None else os.getpid()
+    rng = random.Random(seed)
     attempt = 0
     while True:
         try:
@@ -105,7 +120,10 @@ def initialize_multihost(coordinator: Optional[str] = None,
                 ) from e
             elif _is_transient(msg) and attempt < max_retries:
                 attempt += 1
-                delay = backoff_seconds * (2.0 ** (attempt - 1))
+                # Seeded jitter over [1 − jitter, 1]: never exceeds the
+                # exponential envelope, never collapses to lockstep.
+                delay = (backoff_seconds * (2.0 ** (attempt - 1))
+                         * (1.0 - jitter * rng.random()))
                 from poisson_tpu import obs
 
                 obs.inc("multihost.init_retries")

@@ -168,6 +168,95 @@ def _sharded_ops(problem: Problem, a, b, aux, mask, px_size: int,
     )
 
 
+def _run_shard_batched(problem: Problem, a, b, rhs_stack, aux, mask,
+                       px_size, py_size, scaled: bool):
+    """The batch×mesh composition, per shard: the SAME masked vmapped
+    body ``solvers.batched.pcg_loop_batched`` runs on every shard over
+    a (B, m̂+2, n̂+2) stack of local RHS blocks — vmap INSIDE the shard
+    is exactly "vmap outside shard_map" spelled SPMD: the mesh splits
+    the grid, the batch axis rides whole on every device, and each
+    member's psum'd reductions are per-member mesh scalars (the vmapped
+    ``lax.psum`` reduces elementwise over the batch axis). Per-member
+    convergence masking is untouched, so a member's stop flag and
+    iteration count follow the exact batched-driver semantics; halo
+    exchange and coefficient traffic are paid once per iteration for
+    the whole batch (the amortization this composition exists for)."""
+    from poisson_tpu.solvers.batched import pcg_loop_batched
+
+    ops = _sharded_ops(problem, a, b, aux, mask, px_size, py_size, scaled)
+    s = pcg_loop_batched(
+        ops, rhs_stack,
+        delta=problem.delta, max_iter=problem.iteration_cap,
+        weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+    w = s.w * aux if scaled else s.w
+    return w[:, 1:-1, 1:-1], s.k, s.diff, s.zr, s.flag
+
+
+def shard_rhs_stack(rhs_stack, px_size: int, py_size: int, m_blk: int,
+                    n_blk: int):
+    """A (B, M+1, N+1) full-grid RHS stack as halo-inclusive per-shard
+    blocks (Px·Py, B, m̂+2, n̂+2), leading axis in mesh order — the
+    batched mirror of :func:`_host_shard_blocks`' layout, consumed with
+    ``in_specs=P(('x','y'))``."""
+    arr = np.asarray(rhs_stack)
+    nb = arr.shape[0]
+    gm = px_size * m_blk + 2
+    gn = py_size * n_blk + 2
+    full = np.zeros((nb, gm, gn), arr.dtype)
+    full[:, : arr.shape[1], : arr.shape[2]] = arr
+    out = np.empty((px_size * py_size, nb, m_blk + 2, n_blk + 2),
+                   arr.dtype)
+    for px in range(px_size):
+        for py in range(py_size):
+            out[px * py_size + py] = full[
+                :,
+                px * m_blk : px * m_blk + m_blk + 2,
+                py * n_blk : py * n_blk + n_blk + 2,
+            ]
+    return jnp.asarray(out)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def solve_batched_sharded(problem: Problem, mesh: Mesh, dtype_name: str,
+                          scaled: bool, a_blk, b_blk, rhs_blk, aux_blk):
+    """One fused dispatch solving B right-hand sides on an N-device
+    mesh (the ``solve_batched(mesh=)`` engine): compiled once per
+    (bucket, grid, dtype, scaled, mesh shape) — coefficient blocks and
+    the per-member RHS blocks are operands, so every padded request set
+    of a bucket reuses the executable exactly like the single-device
+    driver. Returns a batched :class:`PCGResult` (leading batch axis on
+    ``w``/``iterations``/``diff``/``residual_dot``/``flag``)."""
+    dtype = jnp.dtype(dtype_name)
+    px_size = mesh.shape[X_AXIS]
+    py_size = mesh.shape[Y_AXIS]
+    m_blk = block_size(problem.M - 1, px_size)
+    n_blk = block_size(problem.N - 1, py_size)
+
+    def shard_fn(a, b, rhs, aux):
+        a, b, aux = a[0], b[0], aux[0]
+        rhs = rhs[0]                      # (B, m̂+2, n̂+2) local stack
+        mask, _, _ = _owned_mask(problem, m_blk, n_blk, dtype)
+        rhs = rhs * mask                  # broadcasts over the batch
+        return _run_shard_batched(
+            problem, a, b, rhs, aux, mask, px_size, py_size, scaled
+        )
+
+    spec = P((X_AXIS, Y_AXIS))
+    w_int, k, diff, zr, flag = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(P(None, X_AXIS, Y_AXIS), P(), P(), P(), P()),
+        check_vma=False,
+    )(a_blk, b_blk, rhs_blk, aux_blk)
+    w = jax.vmap(pad_interior)(
+        w_int[:, : problem.M - 1, : problem.N - 1])
+    return PCGResult(w=w, iterations=k, diff=diff, residual_dot=zr,
+                     flag=flag, max_iterations=jnp.max(k))
+
+
 def _run_shard(problem: Problem, a, b, rhs, aux, mask, px_size, py_size,
                scaled: bool):
     ops = _sharded_ops(problem, a, b, aux, mask, px_size, py_size, scaled)

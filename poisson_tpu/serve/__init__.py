@@ -45,10 +45,20 @@ from poisson_tpu.serve.fleet import (
     WORKER_DEAD,
     WORKER_QUARANTINED,
     WORKER_RUNNING,
+    DeviceLossError,
     Worker,
     WorkerCrashError,
     WorkerHangError,
     WorkerPool,
+)
+from poisson_tpu.serve.placement import (
+    RUNG_MESH,
+    RUNG_SHED,
+    RUNG_SINGLE,
+    DeviceRegistry,
+    Placement,
+    PlacementError,
+    elastic_plan,
 )
 from poisson_tpu.serve.journal import (
     JournalReplay,
@@ -66,6 +76,7 @@ from poisson_tpu.serve.types import (
     ERROR_DIVERGENCE,
     ERROR_INTEGRITY,
     ERROR_INTERNAL,
+    ERROR_PLACEMENT,
     ERROR_TRANSIENT,
     OUTCOME_ERROR,
     OUTCOME_RESULT,
@@ -88,16 +99,20 @@ from poisson_tpu.serve.types import (
 
 __all__ = [
     "BreakerPolicy", "CircuitBreaker", "CLOSED", "Deadline",
-    "DegradationPolicy", "ERROR_DIVERGENCE", "ERROR_INTEGRITY",
-    "ERROR_INTERNAL",
+    "DegradationPolicy", "DeviceLossError", "DeviceRegistry",
+    "ERROR_DIVERGENCE", "ERROR_INTEGRITY",
+    "ERROR_INTERNAL", "ERROR_PLACEMENT",
     "ERROR_TRANSIENT", "FleetPolicy", "HALF_OPEN", "IntegrityPolicy",
     "JournalReplay",
     "OPEN", "Outcome", "OUTCOME_ERROR",
-    "OUTCOME_RESULT", "OUTCOME_SHED", "PendingRequest", "RetryPolicy",
+    "OUTCOME_RESULT", "OUTCOME_SHED", "PendingRequest", "Placement",
+    "PlacementError", "RetryPolicy",
+    "RUNG_MESH", "RUNG_SHED", "RUNG_SINGLE",
     "SCHED_CONTINUOUS", "SCHED_DRAIN", "ServicePolicy",
     "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED", "SHED_QUEUE_FULL",
     "SLOPolicy", "SolveJournal", "SolveRequest", "SolveService",
     "TransientDispatchError", "WORKER_DEAD", "WORKER_QUARANTINED",
     "WORKER_RUNNING", "Worker", "WorkerCrashError", "WorkerHangError",
-    "WorkerPool", "p99_exemplar", "replay_journal", "slowest_requests",
+    "WorkerPool", "elastic_plan", "p99_exemplar", "replay_journal",
+    "slowest_requests",
 ]

@@ -46,6 +46,7 @@ from typing import Callable, List, Optional
 
 from poisson_tpu import obs
 from poisson_tpu.parallel.watchdog import Watchdog
+from poisson_tpu.serve.placement import DeviceRegistry, PlacementError
 from poisson_tpu.serve.types import FleetPolicy
 
 WORKER_RUNNING = "running"
@@ -69,16 +70,33 @@ class WorkerHangError(RuntimeError):
     ``watchdog.stalls`` first."""
 
 
+class DeviceLossError(WorkerCrashError):
+    """The worker's *device* died mid-dispatch (the XLA
+    device-unavailable shape: a chip dropping off the ICI, a host
+    losing its PCIe link, injected chaos). A strict superset of a
+    worker crash: the fault domain is the silicon, so the supervisor
+    quarantines EVERY worker bound to the lost device — not just the
+    one whose dispatch surfaced the loss — marks the device lost in
+    the placement registry (epoch bump), and rebinds the quarantined
+    workers to surviving devices at restart. ``device_id`` names the
+    lost fault domain; None means "whatever the dispatching worker is
+    bound to" (the bench churn injector's case)."""
+
+    def __init__(self, message: str, device_id: Optional[int] = None):
+        super().__init__(message)
+        self.device_id = device_id
+
+
 class Worker:
     """One dispatch context: sticky executables, breaker registry, lane
     table, heartbeat. Scheduled by the pool; stepped by the service."""
 
     __slots__ = ("id", "state", "breakers", "table", "watchdog",
                  "sticky", "restarts", "quarantined_until",
-                 "quarantine_reason")
+                 "quarantine_reason", "placement")
 
     def __init__(self, worker_id: int, timeout: float,
-                 clock: Callable[[], float]):
+                 clock: Callable[[], float], placement=None):
         self.id = worker_id
         self.state = WORKER_RUNNING
         self.breakers: dict = {}
@@ -90,6 +108,11 @@ class Worker:
         self.restarts = 0
         self.quarantined_until = 0.0
         self.quarantine_reason = ""
+        # serve.placement.Placement: the device this worker is bound to
+        # — sticky executables compile ON it, breaker/integrity cohorts
+        # key on it, and a device loss quarantines every worker that
+        # shares it (the fault domain).
+        self.placement = placement
 
 
 class WorkerPool:
@@ -99,20 +122,37 @@ class WorkerPool:
     the service to run a dispatch, never a second source of truth."""
 
     def __init__(self, policy: FleetPolicy,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[DeviceRegistry] = None):
         if policy.workers < 1:
             raise ValueError("fleet.workers must be >= 1")
         if policy.max_restarts < 0:
             raise ValueError("fleet.max_restarts must be >= 0")
         self.policy = policy
         self._clock = clock
+        # The placement registry binds every worker to a device slot at
+        # construction (round-robin over the topology). The default —
+        # one slot on the process's first device — reproduces the
+        # pre-placement fleet exactly: every worker on the default
+        # device, one fault domain.
+        self.registry = registry if registry is not None else \
+            DeviceRegistry(count=policy.devices
+                           if policy.devices is not None else 1)
         self.workers: List[Worker] = [
-            Worker(i, policy.heartbeat_timeout, clock)
+            Worker(i, policy.heartbeat_timeout, clock,
+                   placement=self.registry.bind(i))
             for i in range(policy.workers)
         ]
         self._rr = 0
         obs.gauge("serve.fleet.workers", policy.workers)
         self._publish()
+
+    def workers_on_device(self, device_id: int) -> List[Worker]:
+        """Every worker bound to fault domain ``device_id`` — who
+        shares a fate when that silicon dies."""
+        return [w for w in self.workers
+                if w.placement is not None
+                and w.placement.device_id == int(device_id)]
 
     # -- scheduling ----------------------------------------------------
 
@@ -177,7 +217,13 @@ class WorkerPool:
         """QUARANTINED → RUNNING through warm-up, or → DEAD when the
         restart budget is spent. Returns the sticky map to warm (the
         service runs the compiles — the pool holds no solver imports),
-        or None when the worker died instead."""
+        or None when the worker died instead.
+
+        Topology-aware: a worker whose bound device died since the
+        quarantine is REBOUND to a surviving device before it runs
+        again (its sticky executables recompile there through the
+        ordinary warm-up); with no survivor at all the worker dies —
+        restarts cannot manufacture silicon."""
         if worker.restarts >= self.policy.max_restarts:
             worker.state = WORKER_DEAD
             obs.inc("serve.fleet.worker_deaths")
@@ -186,6 +232,23 @@ class WorkerPool:
                       reason=worker.quarantine_reason)
             self._publish()
             return None
+        if (worker.placement is not None
+                and not self.registry.is_alive(worker.placement.device_id)):
+            try:
+                rebound = self.registry.bind(worker.id)
+            except PlacementError:
+                worker.state = WORKER_DEAD
+                obs.inc("serve.fleet.worker_deaths")
+                obs.event("serve.fleet.worker_dead", worker=worker.id,
+                          restarts=worker.restarts, reason="no_devices")
+                self._publish()
+                return None
+            obs.inc("serve.placement.rebinds")
+            obs.event("serve.placement.rebind", worker=worker.id,
+                      from_device=worker.placement.device_id,
+                      to_device=rebound.device_id,
+                      epoch=rebound.epoch)
+            worker.placement = rebound
         worker.restarts += 1
         worker.state = WORKER_RUNNING
         # A fresh heartbeat watchdog: the stall verdict is one-shot per

@@ -55,7 +55,7 @@ SCHEMA = "poisson_tpu.serve.journal/1"
 # needs to rebuild the SolveRequest; ``on_chunk`` hooks are process
 # handles and deliberately do not survive — recovery notes their loss).
 _REQUEST_FIELDS = ("rhs_gate", "dtype", "deadline_seconds", "chunk",
-                   "max_attempts")
+                   "max_attempts", "device_id")
 _PROBLEM_FIELDS = ("M", "N", "x_min", "x_max", "y_min", "y_max", "f_val",
                    "delta", "max_iter", "weighted_norm")
 
@@ -149,6 +149,12 @@ class PendingRequest:
     taint_fp: Set[str] = dataclasses.field(default_factory=set)
     generation: int = 1          # 1 + prior recover records for this id
     lost_hook: bool = False      # an on_chunk hook did not survive
+    # Placement at the crash (serve.placement): the fault-domain slot
+    # the last dispatch/splice put this request on, and the placement
+    # epoch it was recorded under — what lets a recovery on a DIFFERENT
+    # topology see that the device is gone and remap audibly.
+    device_id: Optional[int] = None
+    epoch: int = 0
 
 
 @dataclasses.dataclass
@@ -162,6 +168,9 @@ class JournalReplay:
     duplicate_outcomes: List[str] = dataclasses.field(default_factory=list)
     pending: List[PendingRequest] = dataclasses.field(default_factory=list)
     submitted: int = 0
+    # The last topology record in the log (the crashed incarnation's
+    # device view) — recovery compares it against its own registry.
+    topology: Optional[dict] = None
 
     @property
     def lost(self) -> int:
@@ -209,6 +218,7 @@ def replay_journal(path: str) -> JournalReplay:
     taints: Dict[str, Set[str]] = {}          # requeue-recorded taint
     fp_taints: Dict[str, Set[str]] = {}       # geometry-fingerprint taint
     generations: Dict[str, int] = {}
+    last_place: Dict[str, tuple] = {}         # rid -> (device, epoch)
 
     def _close(rid_: str) -> None:
         open_dispatch.pop(rid_, None)
@@ -230,15 +240,23 @@ def replay_journal(path: str) -> JournalReplay:
         rid = str(rec.get("request_id", ""))
         if kind == "submit":
             submits[rid] = rec
+        elif kind == "topology":
+            replay.topology = {k: rec.get(k) for k in
+                               ("devices", "alive", "lost", "epoch",
+                                "kinds")}
         elif kind in ("dispatch", "splice"):
             ids = ([str(i) for i in rec.get("request_ids", [])]
                    if kind == "dispatch" else [rid])
+            place = (rec.get("device"), int(rec.get("epoch", 0) or 0))
             for i in ids:
                 # Attempts = dispatches this request has burned (the
                 # one open at the crash included: it died with the
                 # process, which is exactly what an attempt costs).
                 attempts[i] = attempts.get(i, 0) + 1
                 open_dispatch[i] = set(ids) - {i}
+                # Last-known placement: where this work last ran — the
+                # recovery's remap input on a changed topology.
+                last_place[i] = place
             if kind == "splice":
                 open_lanes.setdefault(rec.get("worker"), set()).add(rid)
         elif kind in ("dispatch_end", "retire", "requeue"):
@@ -297,6 +315,7 @@ def replay_journal(path: str) -> JournalReplay:
                 f"submit {rid!r} unreconstructable: {e}")
             obs.inc("serve.journal.torn_records")
             continue
+        device, epoch = last_place.get(rid, (None, 0))
         replay.pending.append(PendingRequest(
             request=request,
             trace_id=str(rec.get("trace_id", "")),
@@ -308,6 +327,8 @@ def replay_journal(path: str) -> JournalReplay:
             taint_fp=fp_taints.get(rid, set()),
             generation=generations.get(rid, 0) + 1,
             lost_hook=bool(rec.get("has_hook")),
+            device_id=(int(device) if device is not None else None),
+            epoch=epoch,
         ))
     obs.inc("serve.journal.replays")
     obs.event("serve.journal.replay", path=path,

@@ -50,10 +50,15 @@ class LaneTable:
     def __init__(self, cohort: str, problem, dtype, bucket: int,
                  chunk: int, worker_id: int = 0,
                  multi_geometry: bool = False, verify_every: int = 0,
-                 verify_tol=None, preconditioner: str = "jacobi"):
+                 verify_tol=None, preconditioner: str = "jacobi",
+                 device=None):
         self.cohort = cohort
         self.problem = problem
         self.worker_id = worker_id
+        # The owning worker's bound jax.Device (serve.placement): the
+        # lane stepping program compiles and runs there, not on the
+        # process default device.
+        self.device = device
         self.multi_geometry = bool(multi_geometry)
         # The per-lane integrity probe (poisson_tpu.integrity): decided
         # at table construction like multi_geometry — an occupied
@@ -70,6 +75,7 @@ class LaneTable:
             multi_geometry=multi_geometry,
             verify_every=verify_every, verify_tol=verify_tol,
             preconditioner=self.preconditioner,
+            device=device,
             # Chunk-boundary hook (solvers.lanes): each boundary is a
             # timeline event, so a wedged lane program's last boundary
             # is on disk for forensics — attributed to the worker that

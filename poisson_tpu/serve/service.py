@@ -70,6 +70,7 @@ from poisson_tpu import obs
 from poisson_tpu.obs.costs import apportion_compute
 from poisson_tpu.obs.flight import (
     POINT_DEADLINE,
+    POINT_PLACEMENT,
     POINT_QUARANTINE,
     POINT_RECOVERED,
     POINT_RETRY,
@@ -83,16 +84,21 @@ from poisson_tpu.geometry.dsl import fingerprint_of
 from poisson_tpu.serve.breaker import CircuitBreaker
 from poisson_tpu.serve.deadline import Deadline
 from poisson_tpu.serve.fleet import (
+    WORKER_DEAD,
+    WORKER_QUARANTINED,
     WORKER_RUNNING,
+    DeviceLossError,
     Worker,
     WorkerCrashError,
     WorkerHangError,
     WorkerPool,
 )
+from poisson_tpu.serve.placement import PlacementError
 from poisson_tpu.serve.types import (
     ERROR_DIVERGENCE,
     ERROR_INTEGRITY,
     ERROR_INTERNAL,
+    ERROR_PLACEMENT,
     ERROR_TRANSIENT,
     OUTCOME_ERROR,
     OUTCOME_RESULT,
@@ -246,7 +252,16 @@ class SolveService:
         # The worker pool: N dispatch contexts over this one queue and
         # ledger (serve.fleet; workers=1 is the classic single-worker
         # service — same scheduling decisions, same golden outcomes).
+        # The pool's device registry (serve.placement) binds every
+        # worker to a fault-domain slot; fleet.devices=None keeps the
+        # pre-placement topology (one slot, the default device).
         self._pool = WorkerPool(self.policy.fleet, clock=clock)
+        self._registry = self._pool.registry
+        # The worker whose dispatch is currently on the hot path —
+        # single-threaded by design, so hardware-cohort attribution
+        # (suspect taint) can name the device without threading the
+        # worker through every classification call.
+        self._active_worker: Optional[Worker] = None
         # Flight recorder + SLO tracker (obs.flight): per-request causal
         # span trees on the service clock, latency decomposition on
         # every outcome, and the serve.slo.* accounting the degradation
@@ -255,6 +270,11 @@ class SolveService:
         # unconfigured.
         self._flight = FlightRecorder(clock=clock)
         self._slo = SLOTracker(self.policy.slo, clock=clock)
+        if self._journal is not None:
+            # The journal opens with this incarnation's topology, so a
+            # recovery on a DIFFERENT topology can see the change and
+            # remap audibly instead of resuming onto ghost device ids.
+            self._journal.record("topology", **self._registry.describe())
 
     # -- admission -----------------------------------------------------
 
@@ -290,6 +310,24 @@ class SolveService:
 
             resolve_preconditioner(pre)
             validate_mg_problem(request.problem)
+        # A placement pin outside the fleet topology — or to a healthy
+        # device no worker is bound to (the pin could never be served)
+        # — is a caller bug, loud at admission (same contract as a
+        # typo'd preconditioner). A pin to a device that DIED is
+        # admitted and becomes a typed ``placement`` error at dispatch
+        # — the silicon's fate is not the caller's mistake.
+        if request.device_id is not None:
+            pin = int(request.device_id)
+            if not 0 <= pin < len(self._registry):
+                raise ValueError(
+                    f"device_id {request.device_id} outside the fleet "
+                    f"topology (devices 0..{len(self._registry) - 1})")
+            if (self._registry.is_alive(pin)
+                    and not self._pool.workers_on_device(pin)):
+                raise ValueError(
+                    f"device_id {pin} has no worker bound to it "
+                    "(workers bind round-robin over the device slots; "
+                    "size fleet.workers >= the highest pinned slot + 1)")
         rid = request.request_id
         recovered_twin = str(rid) in self._recovered_ids
         seen = (rid in self._outcomes or rid in self._prior_outcomes
@@ -356,7 +394,13 @@ class SolveService:
         dead — fails the remaining backlog with typed internal errors,
         so the ledger invariant survives even total fleet loss."""
         self._restart_due_workers()
-        worker = self._pool.next_worker(self._head_cohort())
+        pinned = self._pinned_head_worker()
+        if pinned is not None:
+            worker, verdict = pinned
+            if worker is None:
+                return verdict       # head errored typed / waited out
+        else:
+            worker = self._pool.next_worker(self._head_cohort())
         if worker is None:
             return self._no_worker_step()
         # Beat only when the step has work: the beat marks the step's
@@ -368,10 +412,17 @@ class SolveService:
                           and worker.table.occupied()))
         if active:
             worker.watchdog.beat(worker=worker.id)
-        if self.policy.scheduling == SCHED_CONTINUOUS:
-            progressed = self._step_continuous(worker)
-        else:
-            progressed = self._step(worker)
+        # The scheduled worker is the hardware-attribution context for
+        # everything this step does (dispatch, retire classification,
+        # suspect-cohort taint) — see _hw_cohort.
+        self._active_worker = worker
+        try:
+            if self.policy.scheduling == SCHED_CONTINUOUS:
+                progressed = self._step_continuous(worker)
+            else:
+                progressed = self._step(worker)
+        finally:
+            self._active_worker = None
         if active:
             self._post_step_health(worker)
         return progressed
@@ -389,6 +440,38 @@ class SolveService:
         if not self._queue:
             return None
         return self._cohort(self._queue[0].request)
+
+    def _pinned_head_worker(self):
+        """Placement-pinned head scheduling. None: the head is unpinned
+        (or no head) — ordinary routing applies. Otherwise a
+        ``(worker, progressed)`` pair: a live worker bound to the
+        pinned device, or ``(None, True)`` when the step was consumed
+        resolving the pin — a dead device or a worker-less domain is a
+        typed ``placement`` error (never a wedge), a quarantined
+        domain waits out the earliest release."""
+        if not self._queue or self._queue[0].request.device_id is None:
+            return None
+        pin = int(self._queue[0].request.device_id)
+        if not self._registry.is_alive(pin):
+            head = self._queue.popleft()
+            self._error(head, ERROR_PLACEMENT,
+                        f"pinned device {pin} is lost (placement epoch "
+                        f"{self._registry.epoch})")
+            return (None, True)
+        bound = self._pool.workers_on_device(pin)
+        live = [w for w in bound if w.state == WORKER_RUNNING]
+        if live:
+            return (live[0], True)
+        waiting = [w.quarantined_until for w in bound
+                   if w.state == WORKER_QUARANTINED]
+        if waiting:
+            self._sleep(max(0.0, min(waiting) - self._clock()))
+            return (None, True)
+        head = self._queue.popleft()
+        self._error(head, ERROR_PLACEMENT,
+                    f"no live worker bound to pinned device {pin} "
+                    f"({len(bound)} bound)")
+        return (None, True)
 
     def _restart_due_workers(self) -> None:
         for worker in self._pool.release_due():
@@ -408,26 +491,44 @@ class SolveService:
         if bucket:
             info["buckets"].add(int(bucket))
 
+    def _on_device(self, worker: Worker):
+        """Context manager targeting the worker's BOUND device: sticky
+        executables, warm-up recompiles and lane programs all compile
+        on the silicon the worker lives on — never implicitly on the
+        process default device (which, after a restart or on a
+        multi-device fleet, would cost a cross-device transfer plus a
+        recompile on the first real dispatch)."""
+        import contextlib
+
+        if worker.placement is None or worker.placement.device is None:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(worker.placement.device)
+
     def _warm_worker(self, worker: Worker, sticky: dict) -> None:
         """Restart warm-up: recompile (or jit-cache-hit) each sticky
         bucket executable — at the widths the worker actually
-        dispatched, with degenerate zero-gate members — before the
-        worker takes traffic: a restarted worker must not absorb a
-        compile spike into the first real request's latency. (Lane
-        stepping programs recompile on first table build instead; with
-        cooperative workers the process-wide jit cache usually makes
-        all of this a cache hit — the warm-up is the guarantee, not
-        the common cost.)"""
+        dispatched, with degenerate zero-gate members, ON the worker's
+        bound device (a rebound worker's executables must live where
+        the worker now does) — before the worker takes traffic: a
+        restarted worker must not absorb a compile spike into the
+        first real request's latency. (Lane stepping programs
+        recompile on first table build instead; with cooperative
+        workers the process-wide jit cache usually makes all of this a
+        cache hit — the warm-up is the guarantee, not the common
+        cost.)"""
         from poisson_tpu.solvers.batched import solve_batched
 
         for cohort, info in sticky.items():
             for width in sorted(info["buckets"]) or [1]:
                 try:
-                    solve_batched(info["problem"],
-                                  rhs_gates=[0.0] * width,
-                                  dtype=info["dtype"], bucket=width,
-                                  preconditioner=info.get(
-                                      "preconditioner", "jacobi"))
+                    with self._on_device(worker):
+                        solve_batched(info["problem"],
+                                      rhs_gates=[0.0] * width,
+                                      dtype=info["dtype"], bucket=width,
+                                      preconditioner=info.get(
+                                          "preconditioner", "jacobi"))
                     obs.inc("serve.fleet.warmup_solves")
                 except Exception as e:   # warm-up is best-effort
                     obs.inc("serve.fleet.warmup_failures")
@@ -436,7 +537,9 @@ class SolveService:
                               bucket=width,
                               error=f"{type(e).__name__}: {e}")
         obs.event("serve.fleet.warmed", worker=worker.id,
-                  cohorts=len(sticky))
+                  cohorts=len(sticky),
+                  device=(worker.placement.device_id
+                          if worker.placement else None))
 
     def _post_step_health(self, worker: Worker) -> None:
         """After a step that did NOT raise a worker fault: the heartbeat
@@ -491,9 +594,15 @@ class SolveService:
         """A dispatch raised a worker-level fault: close the affected
         flight spans, evict any lane occupants the worker still holds
         (a solo dispatch can crash a worker whose lane table is live),
-        quarantine it, and recover everything onto the survivors."""
+        quarantine it, and recover everything onto the survivors. A
+        :class:`DeviceLossError` widens the blast radius to the fault
+        DOMAIN: the device is marked lost in the placement registry
+        (epoch bump), every worker bound to it is quarantined with its
+        lane occupants, and the quarantined workers rebind to
+        surviving devices at restart."""
         hang = isinstance(exc, WorkerHangError)
-        reason = "hang" if hang else "crash"
+        loss = isinstance(exc, DeviceLossError)
+        reason = "device_loss" if loss else ("hang" if hang else "crash")
         if hang and worker.watchdog.check() is not None:
             obs.inc("serve.fleet.hangs")
         self._flight_dispatch_failed(entries, did, t0,
@@ -508,7 +617,42 @@ class SolveService:
                 self._flight.end(entry.request.request_id, SPAN_RESIDENT,
                                  error=type(exc).__name__)
         self._pool.quarantine(worker, reason)
+        if loss:
+            extra = extra + self._lose_device(worker, exc)
         self._recover_entries(worker, list(entries) + extra, reason)
+
+    def _lose_device(self, worker: Worker, exc: DeviceLossError
+                     ) -> List[_Entry]:
+        """The fault domain died, not just the dispatching worker: mark
+        the device lost (placement epoch bump, ``serve.fleet.
+        device_losses``), quarantine every OTHER running worker bound
+        to it, and return their evicted lane occupants — all of whom
+        shared the silicon that is gone."""
+        device_id = exc.device_id
+        if device_id is None and worker.placement is not None:
+            device_id = worker.placement.device_id
+        if device_id is None:
+            return []
+        if self._registry.lose(int(device_id)):
+            obs.inc("serve.fleet.device_losses")
+            obs.event("serve.fleet.device_loss", device=int(device_id),
+                      worker=worker.id, epoch=self._registry.epoch,
+                      alive=len(self._registry.alive()))
+        if self._journal is not None:
+            self._journal.record("device_loss", device=int(device_id),
+                                 epoch=self._registry.epoch)
+        evicted: List[_Entry] = []
+        for mate in self._pool.workers_on_device(int(device_id)):
+            if mate is worker or mate.state != WORKER_RUNNING:
+                continue
+            if mate.table is not None:
+                for entry in mate.table.evict_all():
+                    self._flight.end(entry.request.request_id,
+                                     SPAN_RESIDENT, error="device_loss")
+                    evicted.append(entry)
+                mate.table = None
+            self._pool.quarantine(mate, "device_loss")
+        return evicted
 
     def _no_worker_step(self) -> bool:
         """No runnable worker. Wait out the earliest quarantine when one
@@ -632,17 +776,24 @@ class SolveService:
         return base + (":geo" if request.geometry is not None else "")
 
     def _hw_cohort(self) -> tuple:
-        """The (backend, device_kind) pair integrity suspicion taints —
-        hardware identity, not request identity: a bit flip indicts the
-        part it ran on, and every request cohort sharing that part
-        inherits the suspicion (cached: device identity cannot change
-        inside one process)."""
+        """The (backend, device_kind, device_id) triple integrity
+        suspicion taints — hardware identity at placement granularity:
+        a bit flip indicts the PART it ran on (Hochschild 2021), so the
+        suspicion keys on the dispatching worker's bound fault domain,
+        and only the request cohorts sharing that device inherit it —
+        a flip on device 3 never arms defensive verification on device
+        5's dispatches. Outside a dispatch (no active worker) the
+        process default device stands in."""
+        worker = self._active_worker
+        if worker is not None and worker.placement is not None:
+            p = worker.placement
+            return ("xla", p.device_kind, p.device_id)
         if not hasattr(self, "_hw_cohort_cache"):
             import jax
 
             dev = jax.devices()[0]
             self._hw_cohort_cache = (
-                "xla", str(getattr(dev, "device_kind", dev.platform)))
+                "xla", str(getattr(dev, "device_kind", dev.platform)), 0)
         return self._hw_cohort_cache
 
     def _verify_params(self, entries=()) -> tuple:
@@ -679,7 +830,8 @@ class SolveService:
             self._suspect_hw.add(cohort)
             obs.inc("serve.integrity.suspect_cohorts")
             obs.event("serve.integrity.suspect_cohort",
-                      backend=cohort[0], device_kind=cohort[1])
+                      backend=cohort[0], device_kind=cohort[1],
+                      device=cohort[2])
 
     def _breaker(self, worker: Worker, cohort: str) -> CircuitBreaker:
         """The ``worker``'s breaker for ``cohort``: breaker state is
@@ -693,13 +845,17 @@ class SolveService:
     def _solo(self, entry: _Entry) -> bool:
         """Chunked single-request dispatch classes: deadline-carrying
         (expiry needs chunk boundaries), explicitly chunked, escalated
-        divergence retries (the resilient driver is single-request), or
+        divergence retries (the resilient driver is single-request),
         MG+geometry requests (per-member hierarchies do not co-batch —
         ``solvers.batched`` rejects the combination loudly, so the
-        service routes it through the chunked solo path instead)."""
+        service routes it through the chunked solo path instead), or
+        placement-pinned requests (the pin binds the dispatch to one
+        worker's device; co-batched members would inherit it
+        silently)."""
         return (entry.deadline is not None
                 or entry.request.chunk is not None
                 or entry.escalate
+                or entry.request.device_id is not None
                 or (entry.request.geometry is not None
                     and self._precond(entry.request) == "mg"))
 
@@ -769,6 +925,7 @@ class SolveService:
         resilient driver is single-request), and MG+geometry requests
         (per-lane hierarchies do not exist yet) still dispatch solo."""
         return (entry.request.chunk is None and not entry.escalate
+                and entry.request.device_id is None
                 and not (entry.request.geometry is not None
                          and self._precond(entry.request) == "mg"))
 
@@ -915,6 +1072,8 @@ class SolveService:
                 multi_geometry=head.request.geometry is not None,
                 verify_every=verify_every, verify_tol=verify_tol,
                 preconditioner=self._precond(head.request),
+                device=(worker.placement.device
+                        if worker.placement else None),
             )
             self._note_sticky(worker, head_cohort, head.request.problem,
                               None if eff_dtype == "auto" else eff_dtype,
@@ -967,8 +1126,12 @@ class SolveService:
             lane = table.splice(entry, entry.request.rhs_gate)
             rid = entry.request.request_id
             if self._journal is not None:
-                self._journal.record("splice", request_id=str(rid),
-                                     worker=worker.id, lane=lane)
+                self._journal.record(
+                    "splice", request_id=str(rid), worker=worker.id,
+                    lane=lane,
+                    device=(worker.placement.device_id
+                            if worker.placement else None),
+                    epoch=self._registry.epoch)
             self._flight.end(rid, SPAN_QUEUE)
             attrs = dict(mode="lane", bucket=table.bucket, lane=lane,
                          level=level, worker=worker.id)
@@ -1009,6 +1172,8 @@ class SolveService:
                 # START, and the post-step stall check must measure this
                 # step's duration — a beat on completion would reset the
                 # baseline and make a slow-but-returning step invisible.
+                # (Placement targeting lives inside LaneBatch.step —
+                # the table was built with the worker's bound device.)
                 table.step()
         except (WorkerCrashError, WorkerHangError) as e:
             self._handle_worker_fault(worker, e, occupants, did, t_step)
@@ -1164,9 +1329,15 @@ class SolveService:
                 attrs["geometry"] = fingerprint_of(entry.request.geometry)
             self._flight.begin(rid, SPAN_RESIDENT, **attrs)
         if self._journal is not None:
+            # The dispatch record carries the placement (device + epoch)
+            # so a recovery on a different topology can see which
+            # silicon the in-flight work was on and remap it audibly.
             self._journal.record(
                 "dispatch", worker=worker.id, mode=mode,
-                request_ids=[str(e.request.request_id) for e in batch])
+                request_ids=[str(e.request.request_id) for e in batch],
+                device=(worker.placement.device_id
+                        if worker.placement else None),
+                epoch=self._registry.epoch)
         t_disp = self._clock()
         try:
             with obs.span("serve.dispatch", fence=False, cohort=cohort,
@@ -1181,12 +1352,14 @@ class SolveService:
                     self._dispatch_fault([e.request for e in batch],
                                          {e.request.request_id: e.attempts
                                           for e in batch})
-                if solo:
-                    member_failed = self._dispatch_solo(head, problem,
-                                                        dtype, did, t_disp)
-                else:
-                    member_failed = self._dispatch_batched(
-                        batch, problem, dtype, exact_bucket, did, t_disp)
+                with self._on_device(worker):
+                    if solo:
+                        member_failed = self._dispatch_solo(
+                            head, problem, dtype, did, t_disp)
+                    else:
+                        member_failed = self._dispatch_batched(
+                            batch, problem, dtype, exact_bucket, did,
+                            t_disp)
                 # No completion beat — see _step_lane_table: the
                 # post-step stall check measures from the pump-level
                 # start-of-step beat.
@@ -1652,6 +1825,32 @@ class SolveService:
                                in_flight=pend.in_flight,
                                lost_hook=pend.lost_hook)
             self._flight.begin(rid, SPAN_QUEUE, recovered=True)
+            # Topology-aware recovery: work that was on a device this
+            # topology no longer has is REMAPPED audibly — never
+            # silently resumed onto a ghost device id. A hard pin that
+            # cannot map is a typed ``placement`` error, not a wedge.
+            dev = pend.device_id
+            if req.device_id is not None and not self._registry.is_alive(
+                    int(req.device_id)):
+                self._flight.end(rid, SPAN_QUEUE)
+                self._error(entry, ERROR_PLACEMENT,
+                            f"recovered request pinned to device "
+                            f"{req.device_id}, which does not exist on "
+                            f"this topology "
+                            f"({len(self._registry)} devices)")
+                continue
+            if dev is not None and not self._registry.is_alive(int(dev)):
+                try:
+                    placement = self._registry.remap(int(dev))
+                except PlacementError as e:
+                    self._flight.end(rid, SPAN_QUEUE)
+                    self._error(entry, ERROR_PLACEMENT, str(e))
+                    continue
+                self._flight.point(rid, POINT_PLACEMENT,
+                                   from_device=int(dev),
+                                   to_device=placement.device_id,
+                                   from_epoch=pend.epoch,
+                                   epoch=self._registry.epoch)
             if self._journal is not None:
                 self._journal.record("recover", request_id=str(rid),
                                      generation=pend.generation,
@@ -1672,6 +1871,14 @@ class SolveService:
                   len(self._queue) + len(self._delayed))
 
     # -- accounting ----------------------------------------------------
+
+    def worker_device(self, worker_id: int) -> Optional[int]:
+        """The fault-domain slot worker ``worker_id`` is bound to (None
+        when unbound) — the placement lookup the device-loss chaos
+        injectors use to target silicon rather than workers."""
+        worker = self._pool.workers[int(worker_id)]
+        return (worker.placement.device_id
+                if worker.placement is not None else None)
 
     def outcomes(self) -> List[Outcome]:
         """Every outcome so far, in completion order."""
@@ -1721,6 +1928,12 @@ class SolveService:
                           else 0.0),
             "breakers": breakers,
             "workers": {w.id: w.state for w in self._pool.workers},
+            "placement": {
+                **self._registry.describe(),
+                "bindings": {w.id: (w.placement.device_id
+                                    if w.placement else None)
+                             for w in self._pool.workers},
+            },
         }
 
     def _publish_stats(self) -> None:

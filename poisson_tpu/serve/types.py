@@ -46,6 +46,10 @@ ERROR_DIVERGENCE = "divergence"
 ERROR_TRANSIENT = "transient"
 ERROR_INTERNAL = "internal"
 ERROR_INTEGRITY = "integrity"
+# A placement that cannot be satisfied on the current topology: a
+# request pinned to a device id that no longer exists (recovery on a
+# smaller topology, a lost fault domain). Typed — never a wedge.
+ERROR_PLACEMENT = "placement"
 
 SHED_QUEUE_FULL = "queue_full"
 SHED_BREAKER_OPEN = "breaker_open"
@@ -101,6 +105,13 @@ class SolveRequest:
     on_chunk: Optional[Callable] = None
     geometry: Optional[object] = None     # geometry.dsl.GeometrySpec
     preconditioner: Optional[str] = None  # None -> policy default
+    # Hard placement pin (serve.placement): the request may only run on
+    # a worker bound to this fault-domain slot — the A/B-on-one-chip
+    # and indict-the-part debugging knob. Validated alive at admission;
+    # a pin whose device dies while the request is pending (or is gone
+    # at journal recovery on a smaller topology) becomes a typed
+    # ``placement`` error, never a wedge. None (default): any worker.
+    device_id: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -215,6 +226,19 @@ class FleetPolicy:
     a default would mistake a legitimately slow large-grid dispatch
     (cold compile included) for a hang and evict healthy lane progress.
     Size it well past the worst healthy step, like the PR 1 watchdog.
+
+    ``devices`` spreads the fleet over real silicon
+    (``serve.placement``): N fault-domain slots backed by
+    ``jax.devices()`` (oversubscribed when fewer physical devices
+    exist — CPU gets real topologies via ``XLA_FLAGS=--xla_force_host_
+    platform_device_count``), workers bound round-robin, sticky bucket
+    executables compiled ON the bound device, breaker/integrity
+    cohorts keyed by ``(device_kind, device_id)``. A
+    :class:`~poisson_tpu.serve.fleet.DeviceLossError` from the
+    worker-fault seam quarantines EVERY worker in the lost fault
+    domain and rebinds them to survivors at restart. None (default):
+    one slot on the process default device — byte-for-byte the
+    pre-placement fleet.
     """
 
     workers: int = 1
@@ -223,6 +247,7 @@ class FleetPolicy:
     max_restarts: int = 3
     recovery_backoff: float = 0.05
     warm_restart: bool = True
+    devices: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -25,10 +25,17 @@ serves many batch sizes: a zero RHS converges degenerately at iteration 1
 iteration and are sliced off before returning. Bucket-cache reuse is
 surfaced via ``obs.metrics`` (``batched.bucket_cache.hits``/``.misses``).
 
-Composition with the sharded path: the batch axis would have to be vmapped
-*outside* ``shard_map`` (members stay whole-grid; the mesh splits the grid,
-not the batch). That wiring does not exist yet, so a ``mesh`` argument is
-explicitly rejected with a clear error instead of silently mis-sharding.
+Composition with the sharded path (``mesh=``): the batch axis is vmapped
+*outside* ``shard_map`` — members stay whole-grid, the mesh splits the
+grid, not the batch. One dispatch then solves B right-hand sides on an
+N-device mesh (``parallel.pcg_sharded.solve_batched_sharded``): the
+vmapped body runs per shard over the local block stack, every
+per-member reduction is a ``psum``-replicated mesh scalar, and the halo
+exchange + coefficient traffic of each iteration are paid once for the
+whole batch. ``mesh=None`` (the default) keeps the single-device
+programs byte-for-byte. Executable families that have no sharded
+program yet (per-member geometries, MG, the in-loop integrity probe)
+are rejected loudly when combined with ``mesh=``.
 """
 
 from __future__ import annotations
@@ -364,9 +371,19 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     leading batch axis (``iterations`` is the per-member truth) plus the
     scalar ``max_iterations`` the fused loop actually ran.
 
-    ``dtype``/``scaled`` follow ``pcg_solve``'s precision policy. ``mesh``
-    is rejected: the batch axis must be vmapped OUTSIDE ``shard_map``, and
-    that composition is not wired up yet.
+    ``dtype``/``scaled`` follow ``pcg_solve``'s precision policy.
+
+    ``mesh`` (a :class:`jax.sharding.Mesh` from
+    ``parallel.mesh.make_solver_mesh``) runs the whole bucket as ONE
+    sharded dispatch — vmap outside ``shard_map``: members stay
+    whole-grid, the mesh splits the grid, halo exchange amortizes over
+    the batch. Per-member iteration counts and stop flags reproduce the
+    unsharded batched driver (iterates agree to reduction-order ULPs —
+    ``psum`` of shard-local sums associates differently than one
+    full-grid sum; pinned by tests/test_placement.py). ``mesh=None``
+    keeps the historical single-device executables byte-for-byte.
+    Combinations without a sharded program (``geometries``, MG,
+    ``verify_every`` > 0) are rejected loudly.
 
     ``member_ids`` (optional, one hashable id per member) rides through
     padding and slicing onto ``PCGResult.origin``, so position ``i`` of
@@ -417,13 +434,28 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     service dispatches geometry+MG requests solo).
     """
     if mesh is not None:
-        raise ValueError(
-            "solve_batched does not compose with a device mesh yet: the "
-            "batch axis must be vmapped OUTSIDE shard_map (members stay "
-            "whole-grid; the mesh splits the grid, not the batch). Run "
-            "solve_batched on a single device, or solve members "
-            "individually with parallel.pcg_solve_sharded."
-        )
+        # The batch×mesh composition (vmap outside shard_map — members
+        # stay whole-grid, the mesh splits the grid) is wired for the
+        # plain multi-RHS forms. The orthogonal executable families are
+        # rejected loudly until each grows its own sharded program:
+        if geometries is not None and any(g is not None
+                                          for g in geometries):
+            raise ValueError(
+                "solve_batched(mesh=) does not carry per-member "
+                "geometries yet (stacked canvases need sharded blocks "
+                "per member); drop geometries= or dispatch on a single "
+                "device")
+        if preconditioner not in (None, "jacobi"):
+            raise ValueError(
+                "solve_batched(mesh=) composes with the Jacobi "
+                "(symmetric-scaling) body only; preconditioner="
+                f"{preconditioner!r} needs a sharded hierarchy — "
+                "dispatch MG batches on a single device")
+        if int(verify_every) > 0:
+            raise ValueError(
+                "solve_batched(mesh=) does not trace the per-member "
+                "integrity probe yet; run verify_every=0 on the mesh "
+                "or verified buckets on a single device")
     forms = sum(x is not None for x in (rhs_stack, rhs_gates))
     if problems is None:
         raise ValueError("solve_batched needs problems (a Problem or a "
@@ -596,7 +628,34 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     # flag-off key keeps its historical shape and counter arithmetic.
     verify_key = (("verify", verify_every, v_tol)
                   if verify_every > 0 else None)
-    if geo is not None:
+    if mesh is not None:
+        from poisson_tpu.parallel.mesh import X_AXIS, Y_AXIS, block_size
+        from poisson_tpu.parallel.pcg_sharded import (
+            _host_shard_blocks,
+            shard_rhs_stack,
+            solve_batched_sharded,
+        )
+
+        px_size = mesh.shape[X_AXIS]
+        py_size = mesh.shape[Y_AXIS]
+        m_blk = block_size(problem.M - 1, px_size)
+        n_blk = block_size(problem.N - 1, py_size)
+        # The mesh shape is executable identity (the shard program is
+        # compiled per topology), so sharded buckets form their own
+        # bucket-cache key family — a mesh dispatch never claims to
+        # reuse a single-device executable, and vice versa.
+        key = (size, jit_problem, dtype_name, use_scaled,
+               ("mesh", px_size, py_size))
+        _count_bucket(key, batch, size)
+        a_blk, b_blk, _, aux_blk = _host_shard_blocks(
+            jit_problem, px_size, py_size, m_blk, n_blk, dtype_name,
+            use_scaled)
+        rhs_blk = shard_rhs_stack(rhs_stack, px_size, py_size, m_blk,
+                                  n_blk)
+        result = solve_batched_sharded(jit_problem, mesh, dtype_name,
+                                       use_scaled, a_blk, b_blk,
+                                       rhs_blk, aux_blk)
+    elif geo is not None:
         def stack_pad(idx):
             stack = jnp.stack([s[idx] for s in setups])
             if size > batch:

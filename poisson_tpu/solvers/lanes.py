@@ -273,11 +273,15 @@ class LaneBatch:
                  scaled=None, chunk: int = 50, on_boundary=None,
                  multi_geometry: bool = False, verify_every: int = 0,
                  verify_tol=None, preconditioner: str = "jacobi",
-                 mg_config=None):
+                 mg_config=None, device=None):
         if bucket < 1:
             raise ValueError(f"bucket must be >= 1, got {bucket}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # Bound device (serve.placement): the lane state lives — and the
+        # stepping/splice programs compile and run — on this jax.Device.
+        # None keeps the historical default-device behavior exactly.
+        self.device = device
         # MG lanes (poisson_tpu.mg): the stepping program's member body
         # carries one V-cycle in apply_Dinv against the SHARED level
         # hierarchy — decided at construction like multi_geometry (an
@@ -381,6 +385,16 @@ class LaneBatch:
         self.steps = 0                # chunk steps executed
         self.idle_lane_steps = 0      # Σ over steps of non-ACTIVE lanes
 
+    def _on_device(self):
+        """Placement context: computations (and the executables they
+        compile) target the bound device. A null context when unbound —
+        the historical default-device path, untouched."""
+        if self.device is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return jax.default_device(self.device)
+
     # -- occupancy -----------------------------------------------------
 
     def free_lanes(self) -> List[int]:
@@ -433,30 +447,34 @@ class LaneBatch:
                 geometry=geometry)
         else:
             ga, gb, grhs, gaux = self._a, self._b, self._rhs, self._aux
-        rhs = grhs * jnp.asarray(rhs_gate, grhs.dtype)
-        if self.preconditioner == "mg":
-            from poisson_tpu import obs
-            from poisson_tpu.mg.preconditioner import _member_init_mg
+        with self._on_device():
+            rhs = grhs * jnp.asarray(rhs_gate, grhs.dtype)
+            if self.preconditioner == "mg":
+                from poisson_tpu import obs
+                from poisson_tpu.mg.preconditioner import _member_init_mg
 
-            # One splice = one MG-preconditioned member solve (the
-            # lane-engine leg of the mg.solves rollout counter).
-            obs.inc("mg.solves")
-            member = _member_init_mg(self._jit_problem, self.use_scaled,
-                                     self._mg_config, ga, gb, gaux,
-                                     self._hier, rhs)
-        else:
-            member = _member_init(self._jit_problem, self.use_scaled,
-                                  ga, gb, gaux, rhs)
-        lane_idx = jnp.asarray(lane, jnp.int32)
-        self.state = _set_lane(self.state, lane_idx, member)
-        if self.verify_every > 0:
-            self._rhs_stack = _set_field_lane(self._rhs_stack, lane_idx,
-                                              rhs)
-        if self.multi_geometry:
-            self._a_stack = _set_field_lane(self._a_stack, lane_idx, ga)
-            self._b_stack = _set_field_lane(self._b_stack, lane_idx, gb)
-            self._aux_stack = _set_field_lane(self._aux_stack, lane_idx,
-                                              gaux)
+                # One splice = one MG-preconditioned member solve (the
+                # lane-engine leg of the mg.solves rollout counter).
+                obs.inc("mg.solves")
+                member = _member_init_mg(self._jit_problem,
+                                         self.use_scaled,
+                                         self._mg_config, ga, gb, gaux,
+                                         self._hier, rhs)
+            else:
+                member = _member_init(self._jit_problem, self.use_scaled,
+                                      ga, gb, gaux, rhs)
+            lane_idx = jnp.asarray(lane, jnp.int32)
+            self.state = _set_lane(self.state, lane_idx, member)
+            if self.verify_every > 0:
+                self._rhs_stack = _set_field_lane(self._rhs_stack,
+                                                  lane_idx, rhs)
+            if self.multi_geometry:
+                self._a_stack = _set_field_lane(self._a_stack, lane_idx,
+                                                ga)
+                self._b_stack = _set_field_lane(self._b_stack, lane_idx,
+                                                gb)
+                self._aux_stack = _set_field_lane(self._aux_stack,
+                                                  lane_idx, gaux)
         self.origin[lane] = member_id
         return lane
 
@@ -471,43 +489,50 @@ class LaneBatch:
         active = len(self.active_lanes())
         idle = self.bucket - active
         if active:
-            if self.preconditioner == "mg":
-                from poisson_tpu.mg.preconditioner import _step_lanes_mg
-
-                self.state = _step_lanes_mg(
-                    self._jit_problem, self.use_scaled, self.chunk,
-                    self._mg_config, self.verify_every, self.verify_tol,
-                    self._a, self._b, self._aux, self._hier,
-                    (self._rhs_stack if self.verify_every > 0 else None),
-                    self.state)
-            elif self.verify_every > 0 and self.multi_geometry:
-                self.state = _step_lanes_geo_verify(
-                    self._jit_problem, self.use_scaled, self.chunk,
-                    self.verify_every, self.verify_tol,
-                    self._a_stack, self._b_stack, self._aux_stack,
-                    self._rhs_stack, self.state)
-            elif self.verify_every > 0:
-                self.state = _step_lanes_verify(
-                    self._jit_problem, self.use_scaled, self.chunk,
-                    self.verify_every, self.verify_tol,
-                    self._a, self._b, self._aux, self._rhs_stack,
-                    self.state)
-            elif self.multi_geometry:
-                self.state = _step_lanes_geo(
-                    self._jit_problem, self.use_scaled, self.chunk,
-                    self._a_stack, self._b_stack, self._aux_stack,
-                    self.state)
-            else:
-                self.state = _step_lanes(self._jit_problem,
-                                         self.use_scaled,
-                                         self.chunk, self._a, self._b,
-                                         self._aux, self.state)
+            with self._on_device():
+                self._step_active()
             self.steps += 1
             self.idle_lane_steps += idle
             if self.on_boundary is not None:
                 self.on_boundary({"step": self.steps, "active": active,
                                   "idle": idle, "chunk": self.chunk})
         return {"active": active, "idle": idle}
+
+    def _step_active(self) -> None:
+        """One chunk over the live state, on the bound device (the
+        dispatch body of :meth:`step` — split out so the placement
+        context wraps exactly the compiled work)."""
+        if self.preconditioner == "mg":
+            from poisson_tpu.mg.preconditioner import _step_lanes_mg
+
+            self.state = _step_lanes_mg(
+                self._jit_problem, self.use_scaled, self.chunk,
+                self._mg_config, self.verify_every, self.verify_tol,
+                self._a, self._b, self._aux, self._hier,
+                (self._rhs_stack if self.verify_every > 0 else None),
+                self.state)
+        elif self.verify_every > 0 and self.multi_geometry:
+            self.state = _step_lanes_geo_verify(
+                self._jit_problem, self.use_scaled, self.chunk,
+                self.verify_every, self.verify_tol,
+                self._a_stack, self._b_stack, self._aux_stack,
+                self._rhs_stack, self.state)
+        elif self.verify_every > 0:
+            self.state = _step_lanes_verify(
+                self._jit_problem, self.use_scaled, self.chunk,
+                self.verify_every, self.verify_tol,
+                self._a, self._b, self._aux, self._rhs_stack,
+                self.state)
+        elif self.multi_geometry:
+            self.state = _step_lanes_geo(
+                self._jit_problem, self.use_scaled, self.chunk,
+                self._a_stack, self._b_stack, self._aux_stack,
+                self.state)
+        else:
+            self.state = _step_lanes(self._jit_problem,
+                                     self.use_scaled,
+                                     self.chunk, self._a, self._b,
+                                     self._aux, self.state)
 
     def lane_view(self) -> List[dict]:
         """Host-readable per-lane truth after a step: one dict per lane

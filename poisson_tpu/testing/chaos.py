@@ -87,6 +87,20 @@ sdc-batch-member-isolated   a flipped bit in ONE member of a running
 sdc-refill-splice           SDC lands on a member freshly spliced into
                             a RUNNING bucket: detected and retried
                             without perturbing the in-flight member
+device-loss-mid-dispatch    a DEVICE dies mid-dispatch: its fault
+                            domain is quarantined whole, the in-flight
+                            batch recovers onto survivors, the worker
+                            rebinds to surviving silicon at restart
+mesh-member-drop-replan     losing planned mesh members walks the
+                            elastic ladder (mesh shrink → single
+                            device → shed); the re-planned
+                            solve_batched(mesh=) dispatch reproduces
+                            the unsharded verdicts
+recover-on-smaller-topology journal recovery on a SMALLER topology:
+                            lane-resident work on a dead device is
+                            remapped audibly, a pinned request whose
+                            device is gone gets a typed ``placement``
+                            error, the merged ledger closes
 ==========================  ============================================
 
 Every scenario resets the metrics registry, runs against a
@@ -1394,6 +1408,230 @@ def _sdc_refill_splice(seed: int) -> dict:
         "splices_counted": _counter("serve.refill.splices") >= 2,
     }, {"lane_depths_at_flip": views,
         "late_attempts": outs["late"].attempts})
+
+
+# -- placement / fault-domain scenarios (serve.placement) ---------------
+# The fleet is bound to real device slots (fault domains); these three
+# drill the placement rail end to end: a device dying mid-dispatch
+# (quarantine by fault domain, rebind at restart), the elastic re-plan
+# ladder for sharded work (mesh shrink → single device → shed) beside a
+# real batch×mesh dispatch, and journal recovery on a SMALLER topology
+# (remap audibly, type the unmappable). The invariant stays
+# admitted − (completed + errors + shed) == 0, from the snapshot.
+
+
+@scenario("device-loss-mid-dispatch", group="placement")
+def _device_loss_mid_dispatch(seed: int) -> dict:
+    """A device (not just a worker) dies mid-dispatch: the supervisor
+    marks the fault domain lost (placement epoch bump), quarantines the
+    device's worker, recovers the in-flight batch onto the survivor
+    with mutual taint, and the quarantined worker REBINDS to a
+    surviving device at restart — warm-up recompiling its sticky
+    executables there."""
+    from poisson_tpu.serve import (
+        FleetPolicy,
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+        WORKER_RUNNING,
+    )
+    from poisson_tpu.testing.faults import device_loss_fault
+
+    vc = VirtualClock()
+    holder: dict = {}
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16, max_batch=4,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.05,
+                              backoff_cap=0.1),
+            degradation=_quiet_degradation(),
+            fleet=FleetPolicy(workers=2, devices=2,
+                              quarantine_seconds=0.02,
+                              recovery_backoff=0.05),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        worker_fault=device_loss_fault(
+            {0}, lambda wid: holder["svc"].worker_device(wid)),
+    )
+    holder["svc"] = svc
+    p = _problem()
+    for i in range(4):
+        svc.submit(SolveRequest(request_id=f"d{i}", problem=p,
+                                rhs_gate=1.0 + i / 10))
+    outs = {o.request_id: o for o in svc.drain()}
+    stats = svc.stats()
+    placement = stats["placement"]
+    return _finish("device-loss-mid-dispatch", seed, {
+        "device_loss_counted":
+            _counter("serve.fleet.device_losses") == 1,
+        "epoch_bumped_and_device_marked_lost":
+            placement["epoch"] == 2 and placement["lost"] == [0],
+        "fault_domain_quarantined":
+            _counter("serve.fleet.quarantines") == 1,
+        "in_flight_recovered_onto_survivor":
+            _counter("serve.fleet.recovered_requests") == 4
+            and all(o.converged and o.attempts == 2
+                    for o in outs.values()),
+        "worker_rebound_to_survivor":
+            _counter("serve.placement.rebinds") == 1
+            and set(placement["bindings"].values()) == {1},
+        "fleet_healthy_after": all(
+            s == WORKER_RUNNING for s in stats["workers"].values()),
+    }, {"attempts": sorted(o.attempts for o in outs.values()),
+        "placement": placement})
+
+
+@scenario("mesh-member-drop-replan", group="placement")
+def _mesh_member_drop_replan(seed: int) -> dict:
+    """Losing members of a planned mesh walks the elastic ladder —
+    full mesh → shrunken mesh → single device → shed, each rung
+    counted — while a real ``solve_batched(mesh=)`` dispatch on the
+    re-planned topology reproduces the unsharded batched verdicts
+    (the re-plan changes WHERE the work runs, never what it
+    computes)."""
+    import jax
+
+    from poisson_tpu.parallel.mesh import make_solver_mesh
+    from poisson_tpu.serve import (
+        RUNG_MESH,
+        RUNG_SHED,
+        RUNG_SINGLE,
+        DeviceRegistry,
+        elastic_plan,
+    )
+    from poisson_tpu.solvers.batched import solve_batched
+
+    registry = DeviceRegistry(count=4)
+    rung0, plan0 = elastic_plan(registry, 4)
+    registry.lose(1)
+    rung1, plan1 = elastic_plan(registry, 4)      # shrunken mesh
+    shrink_counted = _counter("serve.degraded.mesh_shrink") == 1
+    # A real sharded dispatch on the re-planned width (bounded by the
+    # physical devices this host actually has — the logical ladder is
+    # exercised identically either way).
+    phys = jax.devices()
+    mesh = make_solver_mesh(phys[: max(1, min(len(phys), len(plan1)))])
+    ref = solve_batched(_problem(), rhs_gates=[1.0, 1.1])
+    got = solve_batched(_problem(), rhs_gates=[1.0, 1.1], mesh=mesh)
+    registry.lose(0)
+    registry.lose(2)
+    rung2, _ = elastic_plan(registry, 4)          # one survivor
+    registry.lose(3)
+    rung3, _ = elastic_plan(registry, 4)          # nothing left
+    return _finish("mesh-member-drop-replan", seed, {
+        "full_mesh_planned": rung0 == RUNG_MESH and plan0 == [0, 1, 2, 3],
+        "member_drop_shrinks_the_mesh": rung1 == RUNG_MESH
+        and plan1 == [0, 2, 3] and shrink_counted,
+        "replanned_dispatch_reproduces_unsharded":
+            bool(np.array_equal(np.asarray(got.iterations),
+                                np.asarray(ref.iterations)))
+            and bool(np.array_equal(np.asarray(got.flag),
+                                    np.asarray(ref.flag)))
+            and bool(np.allclose(np.asarray(got.w), np.asarray(ref.w),
+                                 atol=1e-6)),
+        "single_device_rung": rung2 == RUNG_SINGLE
+        and _counter("serve.degraded.single_device") == 1,
+        "shed_rung": rung3 == RUNG_SHED
+        and _counter("serve.degraded.mesh_shed") == 1,
+        "epoch_tracked_every_loss": registry.epoch == 5,
+    }, {"mesh_devices": int(np.prod(list(mesh.shape.values()))),
+        "plans": [plan0, plan1]})
+
+
+@scenario("recover-on-smaller-topology", group="placement")
+def _recover_on_smaller_topology(seed: int) -> dict:
+    """The crash/recovery drill ACROSS a topology change: a fleet on a
+    2-device topology loses device 0 mid-run (worker rebinds to device
+    1), then the process dies with work lane-resident on device 1 and a
+    request PINNED to device 1 still queued. Recovery runs on a
+    1-device topology: the journal's placement records show device 1 is
+    gone, the lane-resident work is remapped audibly
+    (``serve.placement.remapped`` + a ``placement_remapped`` flight
+    point), the pinned request gets a typed ``placement`` error — and
+    the merged ledger still closes with zero lost."""
+    from poisson_tpu.serve import (
+        FleetPolicy,
+        RetryPolicy,
+        SolveJournal,
+        SolveRequest,
+        SolveService,
+        replay_journal,
+    )
+    from poisson_tpu.testing.faults import device_loss_fault
+
+    p = _problem()
+    with tempfile.TemporaryDirectory(prefix="poisson-topology-") as td:
+        path = os.path.join(td, "serve.journal")
+        vc = VirtualClock()
+        retry = RetryPolicy(max_attempts=4, backoff_base=0.01,
+                            backoff_cap=0.05)
+        policy_a = _continuous_policy(
+            capacity=16, max_batch=2, refill_chunk=10, retry=retry,
+            fleet=FleetPolicy(workers=1, devices=2,
+                              quarantine_seconds=0.02,
+                              recovery_backoff=0.02))
+        holder: dict = {}
+        journal_a = SolveJournal(path, clock=vc)
+        svc_a = SolveService(
+            policy_a, clock=vc, sleep=vc.sleep, seed=seed,
+            journal=journal_a,
+            worker_fault=device_loss_fault(
+                {0}, lambda wid: holder["svc"].worker_device(wid)))
+        holder["svc"] = svc_a
+        for i in range(3):
+            svc_a.submit(SolveRequest(request_id=f"t{i}", problem=p,
+                                      rhs_gate=1.0 + i / 10))
+        # Run past the device loss until the rebound worker (now on
+        # device 1) has finished one request and respliced the rest.
+        while len(svc_a.outcomes()) < 1:
+            svc_a.pump()
+        svc_a.pump()
+        lost_in_phase_a = _counter("serve.fleet.device_losses")
+        # A request pinned to device 1 — alive NOW, gone after the
+        # crash: the recovery topology has only device 0.
+        svc_a.submit(SolveRequest(request_id="pinned", problem=p,
+                                  device_id=1))
+        journal_a.close()                 # the process "dies" here
+        replay_probe = replay_journal(path)
+        in_flight = [pend for pend in replay_probe.pending
+                     if pend.in_flight]
+        policy_b = _continuous_policy(
+            capacity=16, max_batch=2, refill_chunk=10, retry=retry,
+            fleet=FleetPolicy(workers=1, devices=1,
+                              quarantine_seconds=0.02,
+                              recovery_backoff=0.02))
+        journal_b = SolveJournal(path, clock=vc)
+        svc_b = SolveService.recover(journal_b, policy_b, clock=vc,
+                                     sleep=vc.sleep, seed=seed)
+        svc_b.drain()
+        # outcomes() rather than drain()'s return: the unmappable pin
+        # is typed DURING recovery, before the first pump.
+        outs = {o.request_id: o for o in svc_b.outcomes()}
+        stats_b = svc_b.stats()
+        journal_b.close()
+        final = replay_journal(path)
+    survivors = [rid for rid in outs if rid != "pinned"]
+    return _finish("recover-on-smaller-topology", seed, {
+        "device_lost_before_crash": lost_in_phase_a == 1
+        and _counter("serve.placement.rebinds") >= 1,
+        "journal_recorded_the_placement": len(in_flight) >= 1
+        and all(pend.device_id == 1 for pend in in_flight)
+        and replay_probe.topology is not None
+        and replay_probe.topology["devices"] == 2,
+        "remapped_audibly_not_silently":
+            _counter("serve.placement.remapped") >= 1,
+        "survivors_converged_on_new_topology":
+            len(survivors) >= 1
+            and all(outs[rid].converged for rid in survivors),
+        "unmappable_pin_typed_not_wedged":
+            outs["pinned"].kind == "error"
+            and outs["pinned"].error_type == "placement",
+        "merged_ledger_closed": stats_b["lost"] == 0
+        and not final.pending,
+    }, {"in_flight_devices": [pend.device_id for pend in in_flight],
+        "outcomes": {str(k): v.kind for k, v in outs.items()},
+        "recovered": stats_b["recovered"]})
 
 
 # -- campaign runner ----------------------------------------------------

@@ -450,11 +450,15 @@ def slow_worker_fault(seconds: float, sleep):
 
 
 def compose_faults(*faults):
-    """Run several dispatch-seam injectors in order (first raise wins)."""
+    """Run several same-seam injectors in order (first raise wins).
+    Arity-agnostic: composes ``dispatch_fault`` injectors
+    ``(requests, attempts)`` and ``worker_fault`` injectors
+    ``(worker_id, requests, attempts)`` alike — mixing seams in one
+    composition is a caller bug the signatures surface loudly."""
 
-    def fault(requests, attempts):
+    def fault(*args):
         for f in faults:
-            f(requests, attempts)
+            f(*args)
 
     return fault
 
@@ -510,6 +514,98 @@ def worker_hang_fault(worker_ids, stall_seconds: float, advance,
                 f"mid-dispatch (hang {hangs[worker_id]})"
             )
 
+    return fault
+
+
+def device_loss_fault(device_ids, placement_of, losses_per_device: int = 1):
+    """A *device-loss* injector for the service's ``worker_fault`` seam:
+    the first ``losses_per_device`` dispatches (or chunk steps — the
+    seam fires at both) of any worker bound to one of ``device_ids``
+    raise :class:`~poisson_tpu.serve.fleet.DeviceLossError` naming that
+    device — the XLA device-unavailable shape of a chip dropping off
+    the interconnect. The supervisor must mark the device lost
+    (placement epoch bump), quarantine EVERY worker in the fault
+    domain, recover their in-flight requests onto survivors with
+    mutual taint, and rebind the quarantined workers at restart.
+
+    ``placement_of`` maps a worker id to its bound device id (e.g.
+    ``service.worker_device``) — the injector targets silicon, and only
+    the placement registry knows who lives on it."""
+    targets = {int(d) for d in device_ids}
+    losses: dict = {}
+
+    def fault(worker_id, requests, attempts):
+        device = placement_of(worker_id)
+        if device is None or int(device) not in targets:
+            return
+        if losses.get(int(device), 0) >= losses_per_device:
+            return
+        losses[int(device)] = losses.get(int(device), 0) + 1
+        from poisson_tpu.serve.fleet import DeviceLossError
+
+        raise DeviceLossError(
+            f"injected loss of device {device} under worker "
+            f"{worker_id} ({len(requests)} request(s) in flight)",
+            device_id=int(device),
+        )
+
+    return fault
+
+
+def host_drop_fault(host_devices, placement_of):
+    """A *host-drop* injector: every device of one host vanishes
+    together (``host_devices`` — the host's fault-domain slots, e.g.
+    a contiguous run of 4 chips). Each doomed device surfaces its own
+    :class:`~poisson_tpu.serve.fleet.DeviceLossError` as a worker bound
+    to it next dispatches — the honest shape of a host dropping off
+    the network: losses arrive as the survivors notice, not as one
+    atomic event. The supervisor must drain the whole host's fault
+    domains and re-plan onto the surviving hosts."""
+    doomed = {int(d) for d in host_devices}
+    reported: set = set()
+
+    def fault(worker_id, requests, attempts):
+        device = placement_of(worker_id)
+        if device is None or int(device) not in doomed:
+            return
+        if int(device) in reported:
+            return
+        reported.add(int(device))
+        from poisson_tpu.serve.fleet import DeviceLossError
+
+        raise DeviceLossError(
+            f"injected host drop: device {device} gone "
+            f"({len(reported)}/{len(doomed)} of the host's devices "
+            "reported)",
+            device_id=int(device),
+        )
+
+    return fault
+
+
+def kill_device_at(at_seconds: float, clock, losses: int = 1):
+    """Bench-churn injector (``bench.py --serve --devices D
+    --kill-device-at T``): once ``clock()`` passes ``at_seconds``, the
+    next ``losses`` dispatching workers lose their BOUND device —
+    ``DeviceLossError`` with ``device_id=None``, which the supervisor
+    resolves to the dispatching worker's fault domain. Device churn at
+    a wall-clock point in an open-loop run, whichever fault domain
+    happens to hold the dispatch."""
+    state = {"losses": 0}
+
+    def fault(worker_id, requests, attempts):
+        if state["losses"] < losses and clock() >= at_seconds:
+            state["losses"] += 1
+            from poisson_tpu.serve.fleet import DeviceLossError
+
+            raise DeviceLossError(
+                f"injected churn: worker {worker_id}'s device lost at "
+                f"t={clock():.3f}s (loss {state['losses']}/{losses})"
+            )
+
+    # Bench reads this to tell a churned run from one that finished
+    # before the loss was due (see kill_worker_at).
+    fault.state = state
     return fault
 
 

@@ -105,6 +105,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "placement: device-placement & fault-domain suite (worker→"
+        "device binding on the virtual 8-device mesh, batch×mesh "
+        "solve_batched(mesh=) parity, device-loss quarantine/rebind, "
+        "elastic mesh-shrink ladder, journal recovery across a "
+        "topology change; CPU-fast; runs in tier-1, selectable with "
+        "-m placement)",
+    )
+    config.addinivalue_line(
+        "markers",
         "mg: geometric-multigrid preconditioning suite "
         "(default-jacobi-path HLO/golden pins, two-grid convergence "
         "factor, V-cycle apply bit-parity under vmap, per-family "

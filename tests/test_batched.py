@@ -131,10 +131,25 @@ def test_explicit_bucket_and_too_small_bucket():
         solve_batched(p, rhs_gates=(1.0, 2.0), bucket=1)
 
 
-def test_mesh_composition_rejected_with_clear_error():
+def test_mesh_composition_rejects_unwired_families():
+    """mesh= composes with the plain multi-RHS forms (PR 12; parity
+    pinned in tests/test_placement.py); the executable families without
+    a sharded program must still be rejected loudly, never silently
+    mis-sharded."""
+    import jax
+
+    from poisson_tpu.parallel.mesh import make_solver_mesh
+
     p = Problem(M=20, N=20)
-    with pytest.raises(ValueError, match="OUTSIDE shard_map"):
-        solve_batched(p, rhs_gates=(1.0,), mesh=object())
+    mesh = make_solver_mesh(jax.devices()[:1])
+    with pytest.raises(ValueError, match="geometries"):
+        solve_batched(p, rhs_gates=(1.0,), mesh=mesh,
+                      geometries=[{"type": "ellipse"}])
+    with pytest.raises(ValueError, match="Jacobi"):
+        solve_batched(p, rhs_gates=(1.0,), mesh=mesh,
+                      preconditioner="mg")
+    with pytest.raises(ValueError, match="integrity probe"):
+        solve_batched(p, rhs_gates=(1.0,), mesh=mesh, verify_every=5)
 
 
 def test_mismatched_problems_rejected():

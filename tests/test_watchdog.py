@@ -138,11 +138,33 @@ class TestMultihostRetry:
         monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
         with pytest.warns(RuntimeWarning, match="retry"):
             idx = multihost.initialize_multihost(
-                backoff_seconds=0.1, sleep=naps.append
+                backoff_seconds=0.1, sleep=naps.append, seed=0
             )
         assert idx == 0
         assert calls["n"] == 3
-        assert naps == [0.1, 0.2]               # exponential backoff
+        # Exponential backoff with seeded jitter over [1-jitter, 1]:
+        # each delay stays under its exponential envelope and above the
+        # jitter floor — a fleet of hosts retrying a dead coordinator
+        # must not thunder back in lockstep.
+        assert len(naps) == 2
+        for delay, envelope in zip(naps, (0.1, 0.2)):
+            assert envelope * 0.5 <= delay <= envelope
+        # Seeded → reproducible: the same seed yields the same schedule.
+        calls["n"] = 0
+        naps2 = []
+        multihost._initialized = False
+        with pytest.warns(RuntimeWarning, match="retry"):
+            multihost.initialize_multihost(
+                backoff_seconds=0.1, sleep=naps2.append, seed=0)
+        assert naps2 == naps
+        # Different seeds (different hosts) decorrelate.
+        calls["n"] = 0
+        naps3 = []
+        multihost._initialized = False
+        with pytest.warns(RuntimeWarning, match="retry"):
+            multihost.initialize_multihost(
+                backoff_seconds=0.1, sleep=naps3.append, seed=1)
+        assert naps3 != naps
 
     def test_env_driven_exhaustion_degrades_to_single_host(self, multihost,
                                                            monkeypatch):
