@@ -1381,6 +1381,24 @@ def main() -> int:
         obs.event("bench.platform_fallback",
                   probes_failed=len(probe_failures))
 
+    # Program-contract drift telemetry: the lint + registry-drift half
+    # of `python -m poisson_tpu.contracts` is stdlib-ast over the
+    # checkout (<1 s, no lowering) — stamping its verdict as gauges on
+    # every bench run makes contract drift visible in the SAME
+    # Prometheus exposition as the perf numbers it protects
+    # (contracts.findings > 0 on a scrape = a contract is drifting now,
+    # before any byte-pin or sentinel fires). Best-effort: a checker
+    # bug must never take a benchmark down.
+    try:
+        from poisson_tpu.contracts.__main__ import run_contracts
+
+        contracts_report = run_contracts(ledger=False)  # stamps gauges
+        if not contracts_report["ok"]:
+            obs.event("bench.contracts_drift",
+                      findings=contracts_report["counts"]["findings"])
+    except Exception:
+        pass
+
     import jax
 
     # The env pin above covers a fresh import; if jax was already imported

@@ -390,6 +390,31 @@ def load_default_history(root=_ROOT) -> list[dict]:
     return records
 
 
+def load_contracts_report(path) -> dict:
+    """Summarize a ``python -m poisson_tpu.contracts --json`` artifact
+    as a verdict block: ``regression`` on any unsuppressed finding or
+    ledger problem (an unreadable artifact is also a regression — a
+    gate that silently stopped producing evidence is not a passing
+    gate)."""
+    try:
+        raw = json.loads(pathlib.Path(path).read_text())
+        counts = raw["counts"]
+        findings = int(counts["findings"]) + int(
+            counts.get("ledger_problems", 0))
+        return {
+            "source": str(path),
+            "findings": findings,
+            "suppressed": int(counts.get("suppressed", 0)),
+            "rules": int(counts.get("rules", 0)),
+            "verdict": "ok" if raw.get("ok") and findings == 0
+                       else "regression",
+        }
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return {"source": str(path), "findings": None,
+                "note": f"unreadable contracts report: {e!r}",
+                "verdict": "regression"}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=str(_ROOT),
@@ -408,6 +433,15 @@ def main(argv=None) -> int:
                          "an alarm (default 0.25 — run-to-run jitter)")
     ap.add_argument("--pretty", action="store_true",
                     help="indent the JSON verdict")
+    ap.add_argument("--contracts-report", default=None, metavar="JSON",
+                    help="a `python -m poisson_tpu.contracts --json` "
+                         "report to fold into the verdict: any "
+                         "unsuppressed finding or ledger problem is a "
+                         "regression (contract drift is a regression "
+                         "in correctness, judged beside the perf "
+                         "cohorts; this stays stdlib-only — the "
+                         "checker runs separately, we read its "
+                         "artifact)")
     args = ap.parse_args(argv)
 
     if args.history is not None:
@@ -428,6 +462,11 @@ def main(argv=None) -> int:
         print("regress: no bench records found", file=sys.stderr)
         return 2
     report = evaluate(records, k=args.k, rel_tol=args.rel_tol)
+    if args.contracts_report:
+        report["contracts"] = load_contracts_report(args.contracts_report)
+        if report["contracts"]["verdict"] == "regression":
+            report["verdict"] = "regression"
+            report["regressions"].append(args.contracts_report)
     print(json.dumps(report, indent=1 if args.pretty else None))
     return 1 if report["verdict"] == "regression" else 0
 

@@ -18,7 +18,7 @@ Naming convention (dotted, low cardinality):
 - ``checkpoint.writes`` / ``checkpoint.crc_failures`` /
   ``checkpoint.corrupt`` / ``checkpoint.generation_fallbacks``;
 - ``watchdog.beats`` / ``watchdog.stalls``;
-- ``integrity.*`` — the numerical-integrity layer
+- the ``integrity`` family — the numerical-integrity layer
   (``poisson_tpu.integrity``, the silent-data-corruption defense):
   ``integrity.checks`` counts chunk-boundary drift verifications run by
   the resilient driver (one extra stencil application each; the in-loop
@@ -32,7 +32,7 @@ Naming convention (dotted, low cardinality):
   very state that fired; a misfiring detector costs one recheck, never
   a restart). Read ``false_alarms`` next to ``detections``: a nonzero
   ratio on clean fleets means the drift tolerance is mis-sized;
-- ``serve.integrity.*`` — the solve service's SDC response
+- the ``serve.integrity`` family — the solve service's SDC response
   (``ServicePolicy.integrity``): ``serve.integrity.detections``
   (FLAG_INTEGRITY members classified), ``serve.integrity.retries``
   (typed ``integrity`` retries issued),
@@ -76,13 +76,16 @@ Naming convention (dotted, low cardinality):
   slowdown — regress.py and the forensics report read it as such);
 - ``profile.captures`` / ``profile.errors`` — programmatic profiler
   captures (``obs.profile``);
-- ``serve.*`` — the solve service's request ledger
+- the ``serve`` family — the solve service's request ledger
   (``poisson_tpu.serve``), the counters the chaos campaign's
   no-lost-request invariant is asserted from
   (``admitted == completed + errors + shed`` once drained):
-  ``serve.admitted`` / ``serve.completed`` (+ ``.partial``,
-  ``.recovered``) / ``serve.errors.{divergence,transient,internal}`` /
-  ``serve.shed.{queue_full,breaker_open,deadline_expired}``;
+  ``serve.admitted`` / ``serve.completed`` (with
+  ``serve.completed.partial`` and ``serve.completed.recovered``
+  sub-counts) / ``serve.errors`` by typed class
+  (``serve.errors.{divergence,transient,internal,integrity,placement}``)
+  / ``serve.shed`` by typed reason
+  (``serve.shed.{queue_full,breaker_open,deadline_expired}``);
   lifecycle machinery: ``serve.dispatches`` / ``serve.batch_members`` /
   ``serve.retries`` / ``serve.backoff_seconds`` /
   ``serve.requeued.isolated`` / ``serve.escalations`` /
@@ -91,7 +94,7 @@ Naming convention (dotted, low cardinality):
   ``serve.degraded.{padding,iteration_cap,precision}``; plus the
   deadline stops the chunked drivers count
   (``checkpoint.deadline_stops`` / ``resilient.deadline_stops``);
-- ``serve.refill.*`` — the continuous-batching lane table
+- the ``serve.refill`` family — the continuous-batching lane table
   (``serve.refill`` + ``solvers.lanes``, ``ServicePolicy.scheduling=
   "continuous"``): ``serve.refill.splices`` (queued RHS spliced into
   freed lanes of a running bucket executable) /
@@ -100,12 +103,12 @@ Naming convention (dotted, low cardinality):
   chunk step — the fused width paid for open seats) /
   ``serve.refill.refill_denied_by_breaker`` (refill decisions refused
   by an open cohort breaker);
-- ``serve.fleet.*`` — the durable solve fleet (``serve.fleet``,
+- the ``serve.fleet`` family — the durable solve fleet (``serve.fleet``,
   ``ServicePolicy.fleet``): ``serve.fleet.quarantines`` (workers pulled
   from scheduling after a crash/hang/stall verdict) /
   ``serve.fleet.restarts`` (quarantined workers returned through
-  warm-up; ``serve.fleet.warmup_solves``/``.warmup_failures`` count the
-  sticky-bucket recompiles) / ``serve.fleet.worker_deaths`` (restart
+  warm-up; ``serve.fleet.warmup_solves`` and
+  ``serve.fleet.warmup_failures`` count the sticky-bucket recompiles) / ``serve.fleet.worker_deaths`` (restart
   budget exhausted — the worker never schedules again) /
   ``serve.fleet.hangs`` (stall verdicts from the worker heartbeat
   watchdog, landing next to ``watchdog.stalls``) /
@@ -119,7 +122,7 @@ Naming convention (dotted, low cardinality):
   bumps the placement epoch, and all of that is ONE loss; read next to
   ``serve.fleet.quarantines`` to tell "a worker fell" from "the
   silicon under N workers vanished");
-- ``serve.placement.*`` — the device placement registry
+- the ``serve.placement`` family — the device placement registry
   (``serve.placement``, ``FleetPolicy.devices``):
   ``serve.placement.binds`` (worker→device bindings handed out) /
   ``serve.placement.rebinds`` (quarantined workers rebound to a
@@ -134,11 +137,13 @@ Naming convention (dotted, low cardinality):
   exists to rule out) / ``serve.placement.replans`` (elastic
   re-plans of sharded dispatches onto the surviving topology; the
   ladder rungs land on ``serve.degraded.mesh_shrink`` /
-  ``.single_device`` / ``.mesh_shed``, counted like the queue-depth
-  ladder) / gauges ``serve.placement.devices`` / ``.alive`` /
-  ``.epoch`` (the placement epoch — bumped on every loss, carried by
-  journal records so recovery can see the topology changed);
-- ``serve.journal.*`` — the crash-safe write-ahead journal
+  ``serve.degraded.single_device`` / ``serve.degraded.mesh_shed``,
+  counted like the queue-depth ladder) / gauges
+  ``serve.placement.devices`` / ``serve.placement.alive`` /
+  ``serve.placement.epoch`` (the placement epoch — bumped on every
+  loss, carried by journal records so recovery can see the topology
+  changed);
+- the ``serve.journal`` family — the crash-safe write-ahead journal
   (``serve.journal``): ``serve.journal.records`` (CRC-sealed lifecycle
   transitions appended) / ``serve.journal.write_errors`` (appends the
   disk refused — durability degraded, audibly) /
@@ -153,7 +158,10 @@ Naming convention (dotted, low cardinality):
   the ledger (``ServicePolicy.dedup``): a client retry or replayed
   submit whose ``request_id`` was already seen returns the original
   outcome instead of double-admitting;
-- ``mg.*`` — the geometric multigrid preconditioner
+- ``selfcheck.runs`` — ``python -m poisson_tpu.obs.selfcheck``
+  executions (one per run; the smoke command counts itself so its own
+  snapshot artifacts are never empty);
+- the ``mg`` family — the geometric multigrid preconditioner
   (:mod:`poisson_tpu.mg`, ``preconditioner="mg"``): ``mg.solves``
   counts MG-preconditioned solves dispatched (batched members count
   individually — read next to ``pcg.solves.*`` to see the rollout
@@ -166,7 +174,7 @@ Naming convention (dotted, low cardinality):
   the same (problem, dtype, geometry-fingerprint, config). Read next
   to ``geom.cache.{hits,misses}`` — the same setup-reuse story, one
   level up;
-- ``serve.slo.*`` — the flight recorder's SLO accounting
+- the ``serve.slo`` family — the flight recorder's SLO accounting
   (``obs.flight.SLOTracker``, objectives declared in
   ``serve.types.SLOPolicy``): ``serve.slo.good`` / ``serve.slo.bad``
   count outcomes for/against the objective (good = a converged result
@@ -175,6 +183,16 @@ Naming convention (dotted, low cardinality):
   ``serve.degraded.slo_driven`` counts load-level decisions where the
   burn rate (not queue depth) chose the degradation rung
   (``SLOPolicy.degrade_on_burn``).
+
+- the ``contracts`` family — the static program-contract checker
+  (:mod:`poisson_tpu.contracts`, ``python -m poisson_tpu.contracts``):
+  gauges ``contracts.findings`` (unsuppressed lint + drift findings on
+  the tree — nonzero means a contract is drifting *now*, before any
+  byte-pin fires), ``contracts.suppressed`` (inline-suppressed
+  findings, each carrying a reason string), and ``contracts.rules``
+  (active rule count). ``bench.py`` stamps all three on every run so
+  drift is visible in the same Prometheus exposition as the perf
+  telemetry it protects.
 
 Gauge families (``obs.costs`` sets these; ``obs.export`` exposes both
 counters and numeric gauges in Prometheus text format):
@@ -196,6 +214,19 @@ counters and numeric gauges in Prometheus text format):
 - ``roofline.{achieved_gbps,peak_gbps,fraction}`` — measured throughput
   against the platform bandwidth ceiling;
 - ``export.http_port`` — the live ``/metrics`` endpoint's bound port;
+- ``compile_cache.dir`` — the persistent-compilation-cache directory in
+  use (``utils.compile_cache``; a string gauge, skipped audibly by the
+  Prometheus exposition);
+- ``batched.last_bucket`` — the bucket width the most recent batched
+  dispatch padded to (read next to ``batched.padding_members`` to see
+  how much of the fused width was padding);
+- bench headline gauges, one per ``bench.py`` mode so the latest run's
+  verdict is scrapeable beside its counters: ``bench.mlups`` /
+  ``bench.vs_baseline`` (single-solve mode), ``bench.batched_solves_per_sec``
+  / ``bench.batched_speedup`` (``--batch``; the CLI's
+  ``solve-batched --json`` stamps the same measurement as
+  ``batched.solves_per_sec``), and ``bench.verify_overhead_fraction``
+  (``--verify-every`` A/B overhead);
 - ``serve.queue_depth`` / ``serve.load_level`` / ``serve.shed_rate`` /
   ``serve.lost_requests`` / ``serve.p99_latency_seconds`` — service
   health, refreshed on every drain; ``serve.latency_seconds`` is a

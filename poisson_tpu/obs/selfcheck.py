@@ -40,7 +40,12 @@ fault domains (``serve.placement``): a device-loss drill — the fault
 domain quarantined whole, in-flight work recovered onto the surviving
 device, the worker rebound at restart — with the
 ``serve_fleet_device_losses``/``serve_placement_*`` counters surviving
-Prometheus exposition.
+Prometheus exposition. Step 19 runs the program-contract gate
+(``poisson_tpu.contracts``) end to end: trace-safety lint + registry
+drift over the checkout (zero unsuppressed findings), the HLO identity
+ledger against the committed fingerprints (every flag-off program
+structurally clean and byte-stable), and the ``contracts_*`` gauges
+surviving exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -634,6 +639,38 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in place_parsed:
             return _fail(f"exposition lost the {prom_name} metric")
 
+    # 19. Program contracts end to end (poisson_tpu.contracts): the
+    # trace-safety lint + registry drift checks over this checkout must
+    # report zero unsuppressed findings, the HLO identity ledger must
+    # match the committed fingerprints with clean structural
+    # assertions on every flag-off program, and the contracts.*
+    # gauges must survive the Prometheus exposition — the same gate
+    # `python -m poisson_tpu.contracts` and the tier-1 suite run.
+    from poisson_tpu.contracts.__main__ import run_contracts
+
+    contracts_report = run_contracts(ledger=True)
+    if not contracts_report["ok"]:
+        broken = [f"{f['file']}:{f['line']} [{f['rule']}]"
+                  for f in contracts_report["findings"]
+                  if not f.get("suppressed")]
+        broken += [f"ledger:{p['program']} [{p['kind']}]"
+                   for p in (contracts_report["ledger"] or
+                             {"problems": []})["problems"]]
+        return _fail(f"program contracts broken: {broken}")
+    if contracts_report["counts"]["rules"] < 8 \
+            or contracts_report["counts"]["ledger_programs"] < 6:
+        return _fail(
+            f"contracts coverage shrank: "
+            f"{contracts_report['counts']['rules']} rules, "
+            f"{contracts_report['counts']['ledger_programs']} programs")
+    contracts_parsed = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_contracts_findings",
+                      "poisson_tpu_contracts_rules"):
+        if prom_name not in contracts_parsed:
+            return _fail(f"exposition lost the {prom_name} metric")
+    if contracts_parsed["poisson_tpu_contracts_findings"]["value"] != 0:
+        return _fail("contracts.findings gauge nonzero after a clean run")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -651,7 +688,10 @@ def run_selfcheck(out_dir: str) -> int:
           f"restart, 0 false alarms, sdc-verified-restart green), "
           f"multigrid ok ({', '.join(f'{g}: {j}->{m} it' for g, (j, m) in mg_iters.items())}, "
           f"hierarchy cache hit), placement ok ({int(device_losses)} "
-          f"device loss -> {int(rebinds)} rebind, 0 lost) "
+          f"device loss -> {int(rebinds)} rebind, 0 lost), program "
+          f"contracts ok ({contracts_report['counts']['rules']} rules, "
+          f"{contracts_report['counts']['ledger_programs']} ledger "
+          f"programs, 0 findings) "
           f"({out_dir})")
     return 0
 

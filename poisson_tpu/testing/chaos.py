@@ -341,8 +341,12 @@ def _deadline_mid_chunk(seed: int) -> dict:
     )
 
     vc = VirtualClock()
+    # default_chunk is the knob under drill: the deadlined request sets
+    # no chunk of its own, so deadline enforcement happens at the
+    # POLICY-default boundaries (5 iterations — small enough that the
+    # 1.0 s budget expires mid-solve).
     svc = SolveService(
-        ServicePolicy(degradation=_quiet_degradation()),
+        ServicePolicy(default_chunk=5, degradation=_quiet_degradation()),
         clock=vc, sleep=vc.sleep, seed=seed,
     )
     p = _problem()
@@ -352,7 +356,7 @@ def _deadline_mid_chunk(seed: int) -> dict:
         return None
 
     svc.submit(SolveRequest(request_id="deadlined", problem=p,
-                            deadline_seconds=1.0, chunk=5, on_chunk=tick))
+                            deadline_seconds=1.0, on_chunk=tick))
     svc.submit(SolveRequest(request_id="starved", problem=p,
                             deadline_seconds=0.5))
     outs = {o.request_id: o for o in svc.drain()}
@@ -858,8 +862,14 @@ def _fleet_worker_kill_mid_dispatch(seed: int) -> dict:
             retry=RetryPolicy(max_attempts=3, backoff_base=0.05,
                               backoff_cap=0.1),
             degradation=_quiet_degradation(),
+            # warm_restart/max_restarts are pinned, not inherited: the
+            # scenario's checks (restarts >= 1 THROUGH warm-up, fleet
+            # healthy after) are exactly these knobs' behavior — a
+            # changed default must not silently change what this drill
+            # proves.
             fleet=FleetPolicy(workers=2, quarantine_seconds=0.02,
-                              recovery_backoff=0.05),
+                              recovery_backoff=0.05, max_restarts=3,
+                              warm_restart=True),
         ),
         clock=vc, sleep=vc.sleep, seed=seed,
         worker_fault=worker_kill_fault({0}),

@@ -114,6 +114,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "contracts: program-contract checker suite (trace-safety lint "
+        "rules with positive/suppressed fixtures, HLO identity ledger "
+        "round-trip incl. mutated-program detection, registry drift "
+        "checks, the `python -m poisson_tpu.contracts` gate; CPU-fast; "
+        "runs in tier-1, selectable with -m contracts)",
+    )
+    config.addinivalue_line(
+        "markers",
         "mg: geometric-multigrid preconditioning suite "
         "(default-jacobi-path HLO/golden pins, two-grid convergence "
         "factor, V-cycle apply bit-parity under vmap, per-family "

@@ -204,8 +204,6 @@ def test_unbatched_stencil_hlo_unchanged():
     """The batch-axis generalisation must cost the classic path nothing:
     on 2D coefficient fields, apply_A compiles to the byte-identical
     HLO of a literal 2D-only implementation (debug metadata aside)."""
-    import re
-
     import jax
     import jax.numpy as jnp
 
@@ -225,12 +223,14 @@ def test_unbatched_stencil_hlo_unchanged():
         return pad_interior(-(ax + ay))
 
     def hlo(fn):
+        from poisson_tpu.contracts.hlo import strip_hlo_metadata
+
         w = jnp.ones((41, 41))
         a = jnp.ones((41, 41))
         b = jnp.ones((41, 41))
         txt = jax.jit(lambda w, a, b: fn(w, a, b, 0.05, 0.03)).lower(
             w, a, b).compile().as_text()
-        return re.sub(r", metadata=\{[^}]*\}", "", txt)
+        return strip_hlo_metadata(txt)
 
     assert hlo(apply_A) == hlo(apply_A_2d)
 

@@ -19,7 +19,6 @@ The contract under test, layer by layer:
 """
 
 import json
-import re
 
 import jax
 import jax.numpy as jnp
@@ -131,8 +130,10 @@ def test_default_jacobi_path_hlo_byte_identical():
     historical_txt = jax.jit(_solve).lower(
         a, b, rhs, aux).compile().as_text()
 
-    strip = lambda txt: re.sub(r", metadata=\{[^}]*\}", "", txt)
-    assert strip(current_txt) == strip(historical_txt)
+    from poisson_tpu.contracts.hlo import strip_hlo_metadata
+
+    assert strip_hlo_metadata(current_txt) \
+        == strip_hlo_metadata(historical_txt)
 
 
 @pytest.mark.parametrize("M,N,weighted,expected", [

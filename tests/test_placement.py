@@ -106,10 +106,16 @@ def test_mesh_none_path_untouched():
     p = _problem().with_(f_val=1.0)
     a, b, rhs, aux = host_setup(p, "float64", False)
     stack = np.stack([np.asarray(rhs), np.asarray(rhs) * 1.1])
+    from poisson_tpu.contracts.hlo import (
+        COLLECTIVE_MARKERS,
+        assert_no_forbidden,
+    )
+
     lowered = jax.jit(
         functools.partial(_solve_batched.__wrapped__, p, False, 0, 0.0)
     ).lower(a, b, stack, aux).as_text()
-    assert "shard_map" not in lowered and "psum" not in lowered
+    assert_no_forbidden(lowered, COLLECTIVE_MARKERS,
+                        context="solve_batched(mesh=None)")
     res = solve_batched(p, rhs_stack=stack)
     assert np.asarray(res.iterations).tolist() == [50, 50]
 
