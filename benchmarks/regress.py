@@ -111,6 +111,9 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                verify_every: Optional[int] = None,
                preconditioner: Optional[str] = None,
                device_topology: Optional[str] = None,
+               krylov_mode: Optional[str] = None,
+               deflation: Optional[bool] = None,
+               repeat_fingerprint: Optional[int] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -158,6 +161,17 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # metric's own (sustained solves/sec alarms on a DROP, p99 on a
         # RISE, regardless of topology). Cohort key.
         "device_topology": device_topology,
+        # Krylov-memory records (bench.py --krylov-block / --serve
+        # --repeat-fingerprint): the batched recurrence mode, the
+        # deflation bit, and the repeat-family count are experiment
+        # identity — a block iteration searches B directions per step
+        # and a warm-dominated repeat-fingerprint load answers mostly
+        # from cached bases, so neither may judge (or hide behind) an
+        # independent/cold baseline. Cohort key, direction pins stay
+        # the metric's own (solves/sec alarms on a DROP either way).
+        "krylov_mode": krylov_mode,
+        "deflation": deflation,
+        "repeat_fingerprint": repeat_fingerprint,
         "failed": bool(failed),
         "note": note,
     }
@@ -196,6 +210,9 @@ def record_from_result(result: dict, source: str,
         verify_every=det.get("verify_every"),
         preconditioner=det.get("preconditioner"),
         device_topology=det.get("device_topology"),
+        krylov_mode=det.get("krylov_mode"),
+        deflation=det.get("deflation"),
+        repeat_fingerprint=det.get("repeat_fingerprint"),
     )
 
 
@@ -286,19 +303,25 @@ def cohort_key(rec: dict):
     grid, same dtype, same platform/backend/device-count — and, for
     service-mode records, the same injected fault load, the same
     open-loop arrival rate, the same fleet worker count, the same
-    geometry-mix family count, the same integrity-probe stride, AND the
-    same preconditioner (fault-load runs are never judged against clean
-    baselines; throughput at one offered load is a different experiment
-    from another; a W-worker fleet never judges a single-worker
-    baseline; a K-family mixed-geometry load never judges a
-    single-ellipse one; a verified solve never indicts an unverified
-    baseline; an MG run never judges a Jacobi one, or vice versa)."""
+    geometry-mix family count, the same integrity-probe stride, the
+    same preconditioner, AND the same Krylov-memory shape — batched
+    recurrence mode, deflation bit, repeat-fingerprint family count
+    (fault-load runs are never judged against clean baselines;
+    throughput at one offered load is a different experiment from
+    another; a W-worker fleet never judges a single-worker baseline; a
+    K-family mixed-geometry load never judges a single-ellipse one; a
+    verified solve never indicts an unverified baseline; an MG run
+    never judges a Jacobi one; a block batch never judges the
+    independent family; a warm repeat-fingerprint run never judges a
+    cold baseline — or vice versa, all of them)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
             rec.get("devices"), rec.get("fault_load"),
             rec.get("arrival_rate"), rec.get("workers"),
             rec.get("geometry_mix"), rec.get("verify_every"),
-            rec.get("preconditioner"), rec.get("device_topology"))
+            rec.get("preconditioner"), rec.get("device_topology"),
+            rec.get("krylov_mode"), rec.get("deflation"),
+            rec.get("repeat_fingerprint"))
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

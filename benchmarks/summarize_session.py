@@ -368,6 +368,47 @@ def telemetry_report(tdir: pathlib.Path) -> int:
                   f"{e.get('drain_solves_per_sec')} sv/s (p99 "
                   f"{e.get('drain_p99_seconds')} s) — {verdict}")
 
+    # Krylov memory (poisson_tpu.krylov): block-mode dispatch traffic,
+    # basis-cache arithmetic, iterations saved by warm starts, and the
+    # repeat-fingerprint bench's cold-vs-warm latency split (gauges
+    # stamped by bench.py --serve --repeat-fingerprint).
+    krylov_counters = {name: val for name, val in counters.items()
+                       if name.startswith(("krylov.", "serve.krylov."))}
+    repeat_fp = [e for e in events if e.get("kind") == "event"
+                 and e.get("name") == "bench.serve_repeat_fingerprint"]
+    if krylov_counters or repeat_fp:
+        print("\n## Krylov memory\n")
+        if krylov_counters:
+            print("| krylov counter | value |")
+            print("|---|---|")
+            for name in sorted(krylov_counters):
+                val = krylov_counters[name]
+                shown = (f"{val:.4f}" if isinstance(val, float)
+                         and val != int(val) else str(int(val)))
+                print(f"| {name} | {shown} |")
+            hits = krylov_counters.get("krylov.cache.hits", 0)
+            misses = krylov_counters.get("krylov.cache.misses", 0)
+            saved = krylov_counters.get("krylov.iterations_saved", 0)
+            total = hits + misses
+            rate = (hits / total) if total else 0.0
+            print(f"\nbasis cache hit rate {rate:.0%} "
+                  f"({int(hits)} hit(s) / {int(misses)} miss(es)); "
+                  f"{int(saved)} iteration(s) saved by warm starts; "
+                  f"{int(krylov_counters.get('krylov.fallbacks', 0))} "
+                  f"stale-basis fallback(s) (each audible, never a "
+                  f"wrong answer).")
+        for e in repeat_fp:
+            grid = e.get("grid") or ["?", "?"]
+            print(f"- {grid[0]}x{grid[1]} @ {e.get('arrival_rate')}/s, "
+                  f"{e.get('repeat_fingerprint')} families "
+                  f"(Zipf repeats): cold p50 "
+                  f"{e.get('cold_p50_seconds')} s "
+                  f"({e.get('cold_requests')} request(s)) vs warm p50 "
+                  f"{e.get('warm_p50_seconds')} s "
+                  f"({e.get('warm_requests')} request(s)), hit rate "
+                  f"{e.get('krylov_hit_rate')} — the repeat-operator "
+                  f"warm-start win, measured.")
+
     # Flight recorder (obs.flight): per-request causal traces and their
     # latency decompositions — render the aggregate view plus ONE
     # request's end-to-end timeline (the slowest, the request a p99

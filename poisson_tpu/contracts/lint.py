@@ -124,6 +124,7 @@ _SOLVER_SCOPE = (
     "poisson_tpu/mg/",
     "poisson_tpu/integrity/",
     "poisson_tpu/parallel/",
+    "poisson_tpu/krylov/",
     "poisson_tpu/obs/stream.py",   # the one sanctioned callback site
 )
 

@@ -145,6 +145,35 @@ def _build_member_init() -> str:
     return _member_init.lower(p, False, a, b, aux, rhs).as_text()
 
 
+def _build_batched_mode_independent() -> str:
+    """solve_batched's mode="independent" default resolved through the
+    REAL entry-point branch (poisson_tpu.krylov threading, PR 14): the
+    mode dispatch is host-side, so the lowered program must be the
+    byte-identical historical bucket executable — this entry's
+    fingerprint must EQUAL batched.mesh_none_f64's (asserted by
+    tests/test_krylov.py on the committed ledger)."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from poisson_tpu.krylov import KRYLOV_INDEPENDENT, resolve_krylov
+    from poisson_tpu.krylov import KrylovPolicy
+    from poisson_tpu.solvers.batched import _solve_batched
+
+    # The default policy must resolve to the independent mode (the
+    # flag-off contract of the whole krylov subsystem)…
+    assert resolve_krylov(None).mode == KRYLOV_INDEPENDENT
+    assert KrylovPolicy().mode == KRYLOV_INDEPENDENT
+    # …and the program it dispatches is the historical one.
+    p = _problem()
+    a, b, rhs, aux = _setup("float64", False)
+    stack = np.stack([np.asarray(rhs), np.asarray(rhs) * 1.1])
+    return jax.jit(
+        functools.partial(_solve_batched.__wrapped__, p, False, 0, 0.0)
+    ).lower(a, b, stack, aux).as_text()
+
+
 def _build_stencil_apply_A() -> str:
     import jax
     import numpy as np
@@ -204,6 +233,15 @@ PROGRAMS: Tuple[ProgramSpec, ...] = (
                     "state construction for every spliced member",
         forbid=_ALL_OFF,
         build=_build_member_init,
+    ),
+    ProgramSpec(
+        name="batched.mode_independent_f64",
+        description="solve_batched mode='independent' (the krylov "
+                    "flag-off default) — must lower to the byte-"
+                    "identical historical bucket executable "
+                    "(fingerprint equals batched.mesh_none_f64)",
+        forbid=_ALL_OFF,
+        build=_build_batched_mode_independent,
     ),
     ProgramSpec(
         name="stencil.apply_A_unbatched",
@@ -423,6 +461,24 @@ ATTRIBUTION_ONLY_DETAIL = {
     "l2_error_vs_analytic": "accuracy payload of the measurement",
     "serial_reduce": "timing-methodology note",
     "iterations_baseline": "unverified-arm payload of the A/B record",
+    # Krylov-memory A/B and repeat-fingerprint payload (cohort key
+    # carries detail.krylov_mode / detail.deflation /
+    # detail.repeat_fingerprint)
+    "krylov_block_ab": "both-arm A/B payload (cohort key carries "
+                       "detail.krylov_mode)",
+    "cold_requests": "arm-size tally of the one run",
+    "warm_requests": "arm-size tally of the one run",
+    "cold_p50_seconds": "cold-arm latency payload (the record's value "
+                        "is the run's sustained throughput)",
+    "cold_p99_seconds": "cold-arm latency payload",
+    "warm_p50_seconds": "warm-arm latency payload",
+    "warm_p99_seconds": "warm-arm latency payload",
+    "krylov_hit_rate": "basis-cache telemetry snapshot",
+    "krylov_harvests": "basis-cache telemetry snapshot",
+    "krylov_iterations_saved": "basis-cache telemetry snapshot",
+    "krylov_fallbacks": "basis-cache telemetry snapshot",
+    "deflated_bytes_per_iter_model": "analytic cost-model reading "
+                                     "(obs.costs.krylov_deflated_cost)",
     # serve-mode latency/throughput payload beside the record's value
     "p95_seconds": "latency payload",
     "shed_rate": "outcome-rate payload (its own gauge exists)",

@@ -163,7 +163,8 @@ def case_by_name(name: str) -> ManufacturedCase:
 
 
 def manufactured_error(case: ManufacturedCase, M: int, N: int,
-                       dtype=None, preconditioner: str = "jacobi") -> dict:
+                       dtype=None, preconditioner: str = "jacobi",
+                       krylov=None) -> dict:
     """Run ``case`` end to end on an M×N grid and measure the weighted
     L2 error over nodes strictly inside D (the BENCH.md oracle rule,
     applied to the family's own exact solution).
@@ -175,7 +176,20 @@ def manufactured_error(case: ManufacturedCase, M: int, N: int,
     preconditioned solve (:mod:`poisson_tpu.mg`) — the hierarchy is
     built from exactly the case's own canvases — which is how every
     geometry family gates MG at its established L2 floor before MG may
-    serve that family (the PR 9 gating rule, generalized verbatim)."""
+    serve that family (the PR 9 gating rule, generalized verbatim).
+
+    ``krylov`` (a :class:`poisson_tpu.krylov.KrylovPolicy`) runs the
+    SAME oracle through the Krylov-memory programs — the same gating
+    rule, generalized once more. ``mode="block"`` solves a 3-member
+    gated block (the case's forcing at gates 1.0/1.35/0.75 — a
+    rank-deficient block by construction, exercising the
+    breakdown-free remedy) and reports the WORST member's relative
+    error against its gate-scaled exact solution (the operator is
+    linear: u(g·f) = g·u). ``deflation=True`` runs the cold
+    harvest-enabled solve on the case's forcing, builds the deflation
+    basis from exactly that solve, then reports the WARM deflated
+    solve at gate 1.4 (``cold_iterations`` rides the report so tests
+    can assert warm-beats-cold at the floor)."""
     import jax.numpy as jnp
 
     from poisson_tpu.geometry.canvas import build_geometry_fields
@@ -200,6 +214,88 @@ def manufactured_error(case: ManufacturedCase, M: int, N: int,
         rhs_use = rhs64
         aux64 = np.pad(d64, 1)
     dt = jnp.dtype(dtype_name)
+
+    def rel_l2(w64, gate=1.0):
+        """Weighted L2 of (w − gate·u) over nodes strictly inside D,
+        relative to ‖gate·u‖ — the BENCH.md oracle rule (linearity:
+        the exact solution of gate·f is gate·u)."""
+        i_idx = np.arange(problem.M + 1)
+        j_idx = np.arange(problem.N + 1)
+        x = (problem.x_min
+             + i_idx.astype(np.float64) * problem.h1)[:, None]
+        y = (problem.y_min
+             + j_idx.astype(np.float64) * problem.h2)[None, :]
+        mask = case.spec.contains(x, y, np)
+        u = np.where(mask, gate * case.u(x, y), 0.0)
+        werr = np.where(mask, (w64 - u) ** 2, 0.0)
+        wnorm = np.where(mask, u ** 2, 0.0)
+        scale = problem.h1 * problem.h2
+        l2 = float(np.sqrt(werr.sum() * scale))
+        norm = float(np.sqrt(wnorm.sum() * scale))
+        return l2, (l2 / norm if norm else float("inf"))
+
+    if krylov is not None:
+        from poisson_tpu.krylov import KRYLOV_BLOCK, resolve_krylov
+
+        kp = resolve_krylov(krylov)
+        if preconditioner not in (None, "jacobi"):
+            raise ValueError(
+                "the krylov oracle gate runs the jacobi body (block/"
+                "deflated programs have no preconditioner composition "
+                f"yet); got preconditioner={preconditioner!r}")
+        A = jnp.asarray(a64, dt)
+        Bc = jnp.asarray(b64, dt)
+        rhs_dev = jnp.asarray(rhs_use, dt)
+        aux_dev = jnp.asarray(aux64, dt)
+        if kp.mode == KRYLOV_BLOCK:
+            from poisson_tpu.krylov.block import _solve_block
+
+            gates = (1.0, 1.35, 0.75)
+            stack = jnp.stack([rhs_dev * g for g in gates])
+            result = _solve_block(problem, use_scaled, A, Bc, stack,
+                                  aux_dev)
+            w = np.asarray(result.w, np.float64)
+            per = [rel_l2(w[j], g) for j, g in enumerate(gates)]
+            worst = max(range(len(gates)), key=lambda j: per[j][1])
+            return {
+                "case": case.name,
+                "l2": per[worst][0],
+                "rel": per[worst][1],
+                "iterations": int(np.asarray(result.max_iterations)),
+                "flag": int(np.asarray(result.flag).max()),
+                "flags": [int(f) for f in np.asarray(result.flag)],
+                "deficient": bool(np.asarray(result.deficient)),
+            }
+        # deflation: cold harvest on the case's forcing, then the warm
+        # deflated solve of the SAME operator at a different gate.
+        from poisson_tpu.krylov.recycle import (
+            _solve_deflated,
+            _solve_harvest,
+            build_basis,
+        )
+
+        cold, y_w, V = _solve_harvest(problem, use_scaled, kp.harvest,
+                                      A, Bc, rhs_dev, aux_dev)
+        basis = build_basis(problem, use_scaled, A, Bc, aux_dev, y_w, V,
+                            int(cold.iterations), kp)
+        if basis is None:
+            raise RuntimeError(
+                f"harvest produced no usable basis for {case.name} "
+                f"(cold flag {int(cold.flag)})")
+        gate = 1.4
+        result = _solve_deflated(problem, use_scaled, A, Bc,
+                                 rhs_dev * gate, aux_dev, *basis)
+        w = np.asarray(result.w, np.float64)
+        l2, rel = rel_l2(w, gate)
+        return {
+            "case": case.name,
+            "l2": l2,
+            "rel": rel,
+            "iterations": int(np.asarray(result.iterations)),
+            "flag": int(np.asarray(result.flag)),
+            "cold_iterations": int(np.asarray(cold.iterations)),
+            "basis_vectors": int(basis[0].shape[0]),
+        }
     if preconditioner not in (None, "jacobi"):
         from poisson_tpu.mg import (
             DEFAULT_MG,
@@ -220,22 +316,11 @@ def manufactured_error(case: ManufacturedCase, M: int, N: int,
                         jnp.asarray(a64, dt), jnp.asarray(b64, dt),
                         jnp.asarray(rhs_use, dt), jnp.asarray(aux64, dt))
 
-    w = np.asarray(result.w, np.float64)
-    i_idx = np.arange(problem.M + 1)
-    j_idx = np.arange(problem.N + 1)
-    x = (problem.x_min + i_idx.astype(np.float64) * problem.h1)[:, None]
-    y = (problem.y_min + j_idx.astype(np.float64) * problem.h2)[None, :]
-    mask = case.spec.contains(x, y, np)
-    u = np.where(mask, case.u(x, y), 0.0)
-    werr = np.where(mask, (w - u) ** 2, 0.0)
-    wnorm = np.where(mask, u ** 2, 0.0)
-    scale = problem.h1 * problem.h2
-    l2 = float(np.sqrt(werr.sum() * scale))
-    norm = float(np.sqrt(wnorm.sum() * scale))
+    l2, rel = rel_l2(np.asarray(result.w, np.float64))
     return {
         "case": case.name,
         "l2": l2,
-        "rel": l2 / norm if norm else float("inf"),
+        "rel": rel,
         "iterations": int(np.asarray(result.iterations)),
         "flag": int(np.asarray(result.flag)),
     }

@@ -1,0 +1,116 @@
+"""Krylov memory: block-CG batched mode and fingerprint-keyed recycling.
+
+Two escalating iteration-count levers over the shared PCG machinery
+(ROADMAP item 4, O'Leary 1980 / Parks & de Sturler 2006 — PAPERS.md):
+
+- **block mode** (:mod:`poisson_tpu.krylov.block`,
+  ``solve_batched(mode="block")``): the batched driver's B independent
+  recurrences become ONE block recurrence carrying the (n × B) iterate
+  with B×B coefficient solves — every member searches the *union* of
+  the members' Krylov spaces, which cuts total iterations on
+  spectrally-rich ("clustered") right-hand-side batches. The B×B
+  systems are solved by a traced eigendecomposition pseudo-inverse, so
+  a rank-deficient block (near-parallel RHS columns) *degrades
+  gracefully* to the effective rank instead of breaking down; a fully
+  degenerate block stamps FLAG_BREAKDOWN through the existing verdict
+  taxonomy.
+
+- **deflation recycling** (:mod:`poisson_tpu.krylov.recycle`,
+  ``solve_recycled``): a production fleet re-solves the same operator —
+  the canvas cache already proves families repeat (``geom.cache.hits``)
+  — so a converged solve harvests a small deflation basis (the
+  solution direction plus Ritz vectors extracted from the Lanczos
+  window the CG recurrence already produces) and caches it beside the
+  canvases, keyed by ``(geometry fingerprint, grid box, dtype, scaled,
+  preconditioner)``. Later requests against the same operator
+  warm-start (init-CG Galerkin projection) and deflate (the projected
+  preconditioner keeps every search direction A-orthogonal to the
+  basis), making the millionth request on a popular geometry
+  structurally cheaper than the first. The cache is a byte-budgeted
+  LRU with audible ``krylov.cache.{hits,misses,evictions,
+  invalidations}`` traffic, SDC-suspect/escalation taint, and
+  journal-safe semantics: a recovered process rebuilds the basis
+  rather than trusting unreplayed device state.
+
+Both modes trade golden-count parity for iteration-count leverage, so
+both are **opt-in and oracle-gated**: the defaults
+(``mode="independent"``, ``deflation=False``) keep every historical
+executable byte-identical (contracts ledger), and the non-default modes
+are gated by the per-family manufactured-solution L2-at-the-floor
+oracle (``geometry.manufactured.manufactured_error(krylov=…)`` — the
+PR 9/11 gate verbatim).
+
+This module is import-light (stdlib only): :class:`KrylovPolicy` rides
+``serve.types`` dataclasses; the jax-heavy solvers live in the
+submodules and are imported lazily by their callers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+KRYLOV_INDEPENDENT = "independent"
+KRYLOV_BLOCK = "block"
+KRYLOV_MODES = (KRYLOV_INDEPENDENT, KRYLOV_BLOCK)
+
+
+@dataclasses.dataclass(frozen=True)
+class KrylovPolicy:
+    """The Krylov-memory knobs a request or service policy carries.
+
+    ``mode`` selects the batched recurrence: ``"independent"`` (the
+    default — the historical vmapped-member program, byte-identical
+    executables, golden counts bit-for-bit) or ``"block"`` (the B×B
+    block recurrence; see :mod:`poisson_tpu.krylov.block`).
+
+    ``deflation`` arms subspace recycling for single-request dispatch:
+    converged solves harvest a deflation basis per geometry fingerprint
+    and later solves against the same operator warm-start/deflate
+    (:mod:`poisson_tpu.krylov.recycle`). ``harvest`` is the Lanczos
+    snapshot window (the first-``harvest`` normalized residuals of the
+    cold solve), ``keep`` the number of Ritz vectors retained (the
+    basis also always carries the converged solution direction — the
+    Galerkin init projection nails pure RHS rescalings with it).
+    ``budget_bytes`` bounds the basis cache (LRU eviction, audible as
+    ``krylov.cache.evictions``).
+
+    Block mode and deflation do not compose yet (the block recurrence
+    has no deflated program); :func:`resolve_krylov` rejects the
+    combination loudly.
+    """
+
+    mode: str = KRYLOV_INDEPENDENT
+    deflation: bool = False
+    harvest: int = 32
+    keep: int = 8
+    budget_bytes: int = 256 * 1024 * 1024
+
+
+DEFAULT_KRYLOV = KrylovPolicy()
+
+
+def resolve_krylov(policy: Optional[KrylovPolicy]) -> KrylovPolicy:
+    """Validate a (possibly None) policy, loudly: an unknown mode or an
+    uncomposable combination must fail at the API edge, never dispatch
+    something silently different from what was asked."""
+    kp = policy or DEFAULT_KRYLOV
+    if kp.mode not in KRYLOV_MODES:
+        raise ValueError(
+            f"unknown krylov mode {kp.mode!r} — expected one of "
+            f"{KRYLOV_MODES}")
+    if kp.mode == KRYLOV_BLOCK and kp.deflation:
+        raise ValueError(
+            "krylov mode='block' does not compose with deflation yet "
+            "(the block recurrence has no deflated program); pick one")
+    if kp.deflation:
+        if kp.keep < 1:
+            raise ValueError(f"krylov.keep must be >= 1, got {kp.keep}")
+        if kp.harvest < kp.keep:
+            raise ValueError(
+                f"krylov.harvest ({kp.harvest}) must be >= keep "
+                f"({kp.keep}) — the Ritz extraction needs at least as "
+                "many snapshots as vectors it keeps")
+        if kp.budget_bytes < 1:
+            raise ValueError("krylov.budget_bytes must be >= 1")
+    return kp

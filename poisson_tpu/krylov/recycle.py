@@ -1,0 +1,444 @@
+"""Solver memory: deflation-basis harvest, cache, and warm-started solves.
+
+A production fleet re-solves the *same operator* over and over — the
+geometry fingerprint cache already proves families repeat
+(``geom.cache.hits``) — yet every solve restarted Krylov from scratch.
+This module gives the fingerprint cache a second tier: **spectral
+memory**.
+
+Harvest (cold solve, :func:`solve_recycled` on a cache miss): the solve
+runs through the exact shared PCG body with one addition — the first
+``harvest`` normalized residual directions ``v_k = z_k/√(z_k,r_k)``
+(the Lanczos basis the CG recurrence already produces) are recorded
+into a ring that rides the fused loop's carry. On convergence the
+Rayleigh–Ritz projection over that window (``T = VᵀAV`` — exactly the
+tridiagonal the CG α/β coefficients define, computed explicitly so f32
+orthogonality loss is handled by the generalized eigenproblem) yields
+``keep`` approximate smallest eigenvectors, and the basis
+
+    W = [ŵ, ritz_1 … ritz_keep]        (ŵ = the converged solution dir)
+
+is cached with its image AW and the inverted coupling matrix
+E = WᵀAW, keyed by ``(fingerprint, grid box, dtype, scaled,
+preconditioner)``.
+
+Warm solve (cache hit): **init-CG projection + deflated operator** —
+the iterate starts from the Galerkin solution in span(W)
+(``x₀ = W E⁻¹ Wᵀb`` — with ŵ in the basis this alone nails pure RHS
+rescalings, the dominant repeat-fingerprint traffic shape), and every
+search direction is kept A-orthogonal to W by composing the deflation
+projector into the preconditioner seam
+(``apply_Dinv → H·M⁻¹, H = I − W E⁻¹ (AW)ᵀ``), which is the ONLY
+change to the loop: the body is ``make_pcg_body`` verbatim and the
+warm start is ``restart_state`` verbatim, so every stop-verdict
+semantics (degenerate guard, non-finite rail, convergence) is
+inherited, not reimplemented.
+
+Safety contract — **never a wrong answer**: the deflated recurrence
+maintains the true residual of the true operator (``r = b − Ax`` by
+construction at init, recursively thereafter), so a corrupt/stale basis
+can only slow the solve or trip a verdict flag, never converge to the
+wrong solution. A warm solve that fails to converge falls back to a
+cold solve audibly (``krylov.fallbacks`` + a ``krylov.fallback``
+event), dropping the implicated basis. The cache invalidates on
+SDC-suspect hardware cohorts and on divergence/integrity escalations
+(the serve layer calls :func:`invalidate`), and is process-local by
+design: journal recovery REBUILDS bases instead of trusting unreplayed
+device state (``SolveService.recover`` invalidates wholesale).
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from poisson_tpu import obs
+from poisson_tpu.config import Problem
+from poisson_tpu.krylov import KrylovPolicy, resolve_krylov
+from poisson_tpu.solvers.pcg import (
+    FLAG_CONVERGED,
+    FLAG_NAMES,
+    PCGResult,
+    init_state,
+    make_pcg_body,
+    resolve_dtype,
+    resolve_scaled,
+    restart_state,
+    scaled_single_device_ops,
+    single_device_ops,
+    solve_setup,
+)
+
+# Guard against a zero ζ in the snapshot normalization (a converged or
+# degenerate member's residual): the recorded direction is then zero
+# and the Rayleigh-Ritz G-filter drops it.
+_ZR_FLOOR = 1e-30
+
+# E = WᵀAW conditioning ceiling: trailing Ritz columns are dropped until
+# the fp64 host inversion is trustworthy (cond below this).
+_E_COND_MAX = 1e10
+
+
+class BasisEntry:
+    """One cached deflation basis (device arrays + host metadata)."""
+
+    __slots__ = ("W", "AW", "Einv", "nbytes", "cold_iterations", "hw",
+                 "fingerprint")
+
+    def __init__(self, W, AW, Einv, cold_iterations: int, hw,
+                 fingerprint: str):
+        self.W = W
+        self.AW = AW
+        self.Einv = Einv
+        self.nbytes = int(W.nbytes + AW.nbytes + Einv.nbytes)
+        self.cold_iterations = int(cold_iterations)
+        self.hw = hw                    # hardware cohort that harvested
+        self.fingerprint = fingerprint
+
+
+_CACHE: "OrderedDict[tuple, BasisEntry]" = OrderedDict()
+
+
+def reset_krylov_cache() -> None:
+    """Forget every cached basis (tests; pair with
+    ``obs.metrics.reset()`` — the ``krylov.cache.*`` counters and this
+    cache must move together or hit/miss arithmetic goes stale)."""
+    _CACHE.clear()
+
+
+def cache_stats() -> dict:
+    """Host-side view of the basis cache (size/bytes/fingerprints)."""
+    return {
+        "entries": len(_CACHE),
+        "bytes": sum(e.nbytes for e in _CACHE.values()),
+        "fingerprints": sorted({e.fingerprint for e in _CACHE.values()}),
+    }
+
+
+def _operator_key(problem: Problem) -> tuple:
+    """The Problem fields the OPERATOR depends on — like
+    ``geometry.canvas._canvas_key`` but without ``f_val``: the deflation
+    basis is a property of A alone (the RHS magnitude rides the init
+    projection's Galerkin coefficient, linearly)."""
+    return (problem.M, problem.N, problem.x_min, problem.x_max,
+            problem.y_min, problem.y_max)
+
+
+def basis_key(problem: Problem, dtype_name: str, scaled: bool,
+              fingerprint: str, preconditioner: str,
+              kp: KrylovPolicy) -> tuple:
+    return (fingerprint, _operator_key(problem), dtype_name,
+            bool(scaled), preconditioner, kp.harvest, kp.keep)
+
+
+def has_basis(problem: Problem, dtype=None, scaled=None, geometry=None,
+              policy: Optional[KrylovPolicy] = None,
+              preconditioner: str = "jacobi") -> bool:
+    """Whether a warm basis exists for this operator (no counters moved
+    — the load generators use this to classify cold vs warm arms)."""
+    from poisson_tpu.geometry.dsl import fingerprint_of, parse_geometry
+
+    kp = policy or KrylovPolicy(deflation=True)
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    spec = parse_geometry(geometry) if geometry is not None else None
+    return basis_key(problem, dtype_name, use_scaled,
+                     fingerprint_of(spec), preconditioner, kp) in _CACHE
+
+
+def invalidate(fingerprint: Optional[str] = None, hw=None,
+               reason: str = "", all_entries: bool = False) -> int:
+    """Drop cached bases, audibly. Select by geometry ``fingerprint``
+    (escalation taint: a family whose solve went bad may be carrying a
+    bad basis), by harvesting hardware cohort ``hw`` (SDC-suspect
+    taint: a basis built on a flip-suspect part is not evidence), or
+    ``all_entries`` (journal recovery: a recovered process rebuilds
+    rather than trusts). Returns the number dropped; every call counts
+    ``krylov.cache.invalidations`` per entry and emits one event."""
+    doomed = [k for k, e in _CACHE.items()
+              if all_entries
+              or (fingerprint is not None and e.fingerprint == fingerprint)
+              or (hw is not None and e.hw == hw)]
+    for k in doomed:
+        del _CACHE[k]
+    if doomed:
+        obs.inc("krylov.cache.invalidations", len(doomed))
+        obs.event("krylov.invalidate", dropped=len(doomed),
+                  reason=reason or "unspecified",
+                  fingerprint=str(fingerprint), hw=str(hw))
+    return len(doomed)
+
+
+def poison_basis(fingerprint: Optional[str] = None) -> int:
+    """Fault-injection seam (``testing.chaos`` deflation-stale-basis):
+    overwrite cached basis arrays with NaNs — the silent-staleness
+    shape. A poisoned basis can never produce a wrong answer (the
+    deflated recurrence maintains the true residual); it produces a
+    non-finite first iterate, which the verdict rail catches and the
+    warm path falls back from, audibly. Returns entries poisoned."""
+    n = 0
+    for entry in _CACHE.values():
+        if fingerprint is None or entry.fingerprint == fingerprint:
+            entry.W = entry.W * jnp.nan
+            n += 1
+    return n
+
+
+def _evict_over_budget(budget_bytes: int) -> None:
+    total = sum(e.nbytes for e in _CACHE.values())
+    while total > budget_bytes and len(_CACHE) > 1:
+        _, old = _CACHE.popitem(last=False)
+        total -= old.nbytes
+        obs.inc("krylov.cache.evictions")
+
+
+# -- traced programs ----------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _solve_harvest(problem: Problem, scaled: bool, m: int, a, b, rhs,
+                   aux):
+    """The cold solve with the snapshot ring riding the carry: the
+    first ``m`` Lanczos directions ``v_k = z_k/√ζ_k`` are recorded at
+    each body entry, then the EXACT shared body steps the state — the
+    iteration arithmetic is ``make_pcg_body`` verbatim (iterates agree
+    with the flag-off program to round-off; the ring writes can shift
+    XLA fusion choices by an ULP, the integrity-probe precedent).
+    Returns (result, y-space final iterate, snapshot ring)."""
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    body0 = make_pcg_body(
+        ops, delta=problem.delta, weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+
+    def body(c):
+        s, V = c
+        rec = s.k < m
+        nrm = jnp.sqrt(jnp.maximum(s.zr, _ZR_FLOOR)).astype(rhs.dtype)
+        V = lax.cond(
+            rec,
+            lambda: V.at[jnp.minimum(s.k, m - 1)].set(s.z / nrm),
+            lambda: V)
+        return (body0(s), V)
+
+    def cond(c):
+        s, _ = c
+        return (~s.done) & (s.k < problem.iteration_cap)
+
+    init = (init_state(ops, rhs),
+            jnp.zeros((m,) + rhs.shape, rhs.dtype))
+    s, V = lax.while_loop(cond, body, init)
+    w = s.w * aux if scaled else s.w
+    return (PCGResult(w=w, iterations=s.k, diff=s.diff,
+                      residual_dot=s.zr, flag=s.flag), s.w, V)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _apply_stack(problem: Problem, scaled: bool, a, b, aux, V):
+    """A applied to a (k, M+1, N+1) stack — the harvest's Rayleigh-Ritz
+    image and the basis image AW, one vmapped stencil program."""
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    return jax.vmap(lambda u: ops.apply_A(ops.exchange(u)))(V)
+
+
+def _deflated_ops(problem: Problem, scaled: bool, a, b, aux, W, AW,
+                  Einv):
+    """The ops bundle with the deflation projector composed into the
+    preconditioner seam: ``apply_Dinv → H·M⁻¹`` with
+    ``H = I − W E⁻¹ (AW)ᵀ`` (weighted dots throughout). Every search
+    direction the shared body builds from these ops stays A-orthogonal
+    to span(W) — the deflated-PCG construction, through the same seam
+    the MG preconditioner plugs into."""
+    ops = (
+        scaled_single_device_ops(problem, a, b, aux)
+        if scaled
+        else single_device_ops(problem, a, b, aux)
+    )
+    h1h2 = problem.h1 * problem.h2
+
+    def deflate(z):
+        d = h1h2 * jnp.einsum("imn,mn->i", AW[:, 1:-1, 1:-1],
+                              z[1:-1, 1:-1])
+        return z - jnp.einsum("imn,i->mn", W, Einv @ d)
+
+    return ops._replace(apply_Dinv=lambda r: deflate(ops.apply_Dinv(r)))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _solve_deflated(problem: Problem, scaled: bool, a, b, rhs, aux, W,
+                    AW, Einv) -> PCGResult:
+    """The warm solve: init-CG Galerkin projection
+    ``x₀ = W E⁻¹ (Wᵀb)`` + the deflated body to convergence. The loop
+    is ``restart_state`` + ``make_pcg_body`` over the deflated ops —
+    verdict semantics inherited verbatim. Compiled once per
+    (grid, dtype, scaled, basis width); the basis arrays are operands,
+    so every fingerprint of the same width shares the executable."""
+    ops_defl = _deflated_ops(problem, scaled, a, b, aux, W, AW, Einv)
+    h1h2 = problem.h1 * problem.h2
+    d0 = h1h2 * jnp.einsum("imn,mn->i", W[:, 1:-1, 1:-1],
+                           rhs[1:-1, 1:-1])
+    x0 = jnp.einsum("imn,i->mn", W, Einv @ d0)
+    body = make_pcg_body(
+        ops_defl, delta=problem.delta,
+        weighted_norm=problem.weighted_norm,
+        h1=problem.h1, h2=problem.h2,
+    )
+    s = lax.while_loop(
+        lambda s: (~s.done) & (s.k < problem.iteration_cap),
+        body, restart_state(ops_defl, rhs, x0))
+    w = s.w * aux if scaled else s.w
+    return PCGResult(w=w, iterations=s.k, diff=s.diff,
+                     residual_dot=s.zr, flag=s.flag)
+
+
+# -- harvest (host-side Rayleigh-Ritz) ----------------------------------
+
+def build_basis(problem: Problem, scaled: bool, a, b, aux, y_w, V,
+                iterations: int, kp: KrylovPolicy):
+    """Rayleigh-Ritz over the snapshot window + the solution direction
+    → (W, AW, Einv) device arrays, or None when the window is unusable.
+
+    The small eigenproblems run in fp64 on the host (the matrices are
+    ``harvest``-sized); the generalized form ``H y = θ G y`` absorbs
+    the f32 orthogonality loss of the recorded Lanczos directions, and
+    trailing Ritz columns are dropped until E = WᵀAW inverts with
+    cond below ``_E_COND_MAX`` — a basis that cannot be applied
+    trustworthily is not cached."""
+    h1h2 = problem.h1 * problem.h2
+    n = min(int(iterations), kp.harvest)
+    if n < 1:
+        return None
+    V = V[:n]
+    AV = _apply_stack(problem, scaled, a, b, aux, V)
+    G = np.asarray(h1h2 * jnp.einsum(
+        "imn,jmn->ij", V[:, 1:-1, 1:-1], V[:, 1:-1, 1:-1]), np.float64)
+    H = np.asarray(h1h2 * jnp.einsum(
+        "imn,jmn->ij", V[:, 1:-1, 1:-1], AV[:, 1:-1, 1:-1]), np.float64)
+    H = 0.5 * (H + H.T)
+    sG, Q = np.linalg.eigh(0.5 * (G + G.T))
+    good = sG > max(float(sG.max()) * 1e-8, 1e-12)
+    if not good.any():
+        return None
+    Bred = Q[:, good] / np.sqrt(sG[good])
+    theta, U = np.linalg.eigh(Bred.T @ H @ Bred)
+    keep = min(kp.keep, int(good.sum()))
+    combo = Bred @ U[:, np.argsort(theta)[:keep]]      # n × keep
+
+    sqn = float(h1h2 * jnp.sum(y_w[1:-1, 1:-1] ** 2))
+    if not np.isfinite(sqn) or sqn <= 0.0:
+        return None
+    w_dir = (y_w / np.sqrt(sqn)).astype(V.dtype)
+    ritz = jnp.einsum("imn,ik->kmn", V, jnp.asarray(combo, V.dtype))
+    W = jnp.concatenate([w_dir[None], ritz])
+    AW = _apply_stack(problem, scaled, a, b, aux, W)
+    E = np.asarray(h1h2 * jnp.einsum(
+        "imn,jmn->ij", W[:, 1:-1, 1:-1], AW[:, 1:-1, 1:-1]), np.float64)
+    E = 0.5 * (E + E.T)
+    # Shrink until the coupling matrix inverts trustworthily (the
+    # solution direction is never dropped — it is the warm start).
+    cols = E.shape[0]
+    while cols > 1 and np.linalg.cond(E[:cols, :cols]) > _E_COND_MAX:
+        cols -= 1
+    if not np.all(np.isfinite(E[:cols, :cols])):
+        return None
+    Einv = jnp.asarray(np.linalg.inv(E[:cols, :cols]), V.dtype)
+    return W[:cols], AW[:cols], Einv
+
+
+# -- the cache-wrapped entry point --------------------------------------
+
+def solve_recycled(problem: Problem, dtype=None, scaled=None,
+                   rhs_gate=None, geometry=None,
+                   policy: Optional[KrylovPolicy] = None,
+                   preconditioner: str = "jacobi",
+                   hw=None) -> PCGResult:
+    """Single-request solve with fingerprint-keyed solver memory.
+
+    Cache hit: the warm deflated solve (``krylov.cache.hits`` /
+    ``krylov.warm_solves``; the net iteration delta vs the family's
+    cold count lands on ``krylov.iterations_saved``). A warm solve
+    that does not converge falls back to a cold solve audibly
+    (``krylov.fallbacks``), dropping the implicated basis — stale
+    memory costs a retry, never a wrong answer.
+
+    Cache miss: the harvest-enabled cold solve; on convergence the
+    basis is built and cached (``krylov.cache.misses`` /
+    ``krylov.harvests``), LRU-evicted over ``policy.budget_bytes``
+    (``krylov.cache.evictions``). ``hw`` tags the entry with the
+    harvesting hardware cohort so SDC suspicion can invalidate it
+    (:func:`invalidate`).
+
+    ``rhs_gate`` scales the RHS like ``pcg_solve``'s knob; the basis
+    key deliberately excludes the magnitude — the Galerkin init
+    projection handles any rescaling of a remembered operator's RHS.
+    """
+    from poisson_tpu.geometry.dsl import fingerprint_of, parse_geometry
+
+    kp = resolve_krylov(policy or KrylovPolicy(deflation=True))
+    if not kp.deflation:
+        raise ValueError("solve_recycled needs a deflation-enabled "
+                         "KrylovPolicy (deflation=True)")
+    if preconditioner not in (None, "jacobi"):
+        raise ValueError(
+            "solver memory composes with the jacobi (symmetric-scaling) "
+            f"body only; preconditioner={preconditioner!r} has no "
+            "deflated program yet — run it without deflation")
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    spec = parse_geometry(geometry) if geometry is not None else None
+    a, b, rhs, aux = solve_setup(problem, dtype_name, use_scaled,
+                                 geometry=spec)
+    if rhs_gate is not None:
+        rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
+    fp = fingerprint_of(spec)
+    key = basis_key(problem, dtype_name, use_scaled, fp, "jacobi", kp)
+
+    entry = _CACHE.get(key)
+    if entry is not None:
+        _CACHE.move_to_end(key)
+        obs.inc("krylov.cache.hits")
+        result = _solve_deflated(problem, use_scaled, a, b, rhs, aux,
+                                 entry.W, entry.AW, entry.Einv)
+        flag = int(result.flag)
+        if flag == FLAG_CONVERGED:
+            obs.inc("krylov.warm_solves")
+            obs.inc("krylov.iterations_saved",
+                    entry.cold_iterations - int(result.iterations))
+            return result
+        # Stale/poisoned/unlucky basis: audible fallback to a cold
+        # solve; the basis is dropped (it is implicated) and rebuilt
+        # by the cold path below if that converges.
+        obs.inc("krylov.fallbacks")
+        obs.event("krylov.fallback", fingerprint=fp,
+                  verdict=FLAG_NAMES.get(flag, str(flag)),
+                  iterations=int(result.iterations))
+        invalidate(fingerprint=fp,
+                   reason=f"warm-solve-{FLAG_NAMES.get(flag, flag)}")
+    else:
+        obs.inc("krylov.cache.misses")
+
+    result, y_w, V = _solve_harvest(problem, use_scaled, kp.harvest,
+                                    a, b, rhs, aux)
+    if int(result.flag) == FLAG_CONVERGED:
+        basis = build_basis(problem, use_scaled, a, b, aux, y_w, V,
+                            int(result.iterations), kp)
+        if basis is not None:
+            W, AW, Einv = basis
+            _CACHE[key] = BasisEntry(W, AW, Einv,
+                                     int(result.iterations), hw, fp)
+            obs.inc("krylov.harvests")
+            _evict_over_budget(kp.budget_bytes)
+    return result

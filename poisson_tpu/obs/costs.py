@@ -210,6 +210,87 @@ def mg_vcycle_cost(M: int, N: int, dtype_bytes: int = 4,
     return report
 
 
+def krylov_block_cost(M: int, N: int, B: int, dtype_bytes: int = 4,
+                      scaled: bool = True) -> dict:
+    """Analytic HLO-operand traffic of ONE block-CG iteration over B
+    right-hand sides (:mod:`poisson_tpu.krylov.block`), in the same
+    operand-pass units as :func:`analytic_iteration_cost` — the model
+    that lets the sentinel and roofline attribution cohort ``:blk``
+    records separately from the independent-mode family.
+
+    Per iteration the block pays B member-iterations of stencil/update
+    traffic (the vmapped body's per-member passes, with the coefficient
+    canvases read ONCE per stencil application and amortized across
+    the B members — the hardware-batching win the independent mode
+    already has) plus the block coupling: three B×B Gram matrices
+    (PᵀQ, PᵀR, QᵀZ — 2 array passes each over B stacks = 6·B passes),
+    two (n × B)·(B × B) recombinations (X/R updates and the direction
+    update, 2 passes each = 6·B counting the orthonormalization's
+    P-recombination), and the B×B eigendecompositions (O(B³) FLOPs,
+    byte-negligible). Fewer block iterations buying more bytes per
+    iteration is exactly the trade the sentinel must see cohorted, not
+    averaged away.
+
+    Returns ``{"bytes", "flops", "bytes_per_member_iteration",
+    "passes_per_member", "coupling_passes"}`` and sets the
+    ``cost.krylov.block_*`` gauges.
+    """
+    base = analytic_iteration_cost(M, N, dtype_bytes, scaled)
+    pts = grid_points(M, N)
+    # Coupling traffic per block iteration, in fine-grid passes: 6B for
+    # the three Gram products + 6B for the three stack recombinations.
+    coupling_passes = 12.0 * B
+    bytes_total = B * base["bytes"] + coupling_passes * pts * dtype_bytes
+    flops = (B * base["flops"]
+             + 3.0 * (2.0 * B * B) * pts       # Gram products
+             + 3.0 * (2.0 * B * B) * pts       # recombinations
+             + 30.0 * B ** 3)                  # B×B eigh/solves
+    report = {
+        "bytes": bytes_total,
+        "flops": flops,
+        "bytes_per_member_iteration": bytes_total / B,
+        "passes_per_member": bytes_total / (B * pts * dtype_bytes),
+        "coupling_passes": coupling_passes,
+    }
+    metrics.gauge("cost.krylov.block_bytes_per_iter", bytes_total)
+    metrics.gauge("cost.krylov.block_flops_per_iter", flops)
+    metrics.gauge("cost.krylov.block_passes_per_member",
+                  report["passes_per_member"])
+    return report
+
+
+def krylov_deflated_cost(M: int, N: int, k: int, dtype_bytes: int = 4,
+                         scaled: bool = True) -> dict:
+    """Analytic HLO-operand traffic of ONE deflated-CG iteration with a
+    k-vector basis (:mod:`poisson_tpu.krylov.recycle`) — the
+    per-iteration surcharge the warm path pays over the plain body,
+    in the same operand-pass units as :func:`analytic_iteration_cost`.
+
+    The deflation projector costs two k-stack passes per iteration
+    (read AW for the k weighted dots, read W for the correction; the
+    k-vector coefficient solve is byte-negligible), so the warm
+    iteration moves ``base + 2k`` passes — fewer iterations buying
+    more bytes per iteration, the trade the sentinel must see
+    cohorted. Returns ``{"bytes", "flops", "passes", "basis_passes"}``
+    and sets the ``cost.krylov.deflated_*`` gauges.
+    """
+    base = analytic_iteration_cost(M, N, dtype_bytes, scaled)
+    pts = grid_points(M, N)
+    basis_passes = 2.0 * k
+    bytes_total = base["bytes"] + basis_passes * pts * dtype_bytes
+    flops = base["flops"] + 2.0 * (2.0 * k) * pts + 2.0 * k * k
+    report = {
+        "bytes": bytes_total,
+        "flops": flops,
+        "passes": bytes_total / (pts * dtype_bytes),
+        "basis_passes": basis_passes,
+    }
+    metrics.gauge("cost.krylov.deflated_bytes_per_iter", bytes_total)
+    metrics.gauge("cost.krylov.deflated_flops_per_iter", flops)
+    metrics.gauge("cost.krylov.deflated_passes", report["passes"])
+    return report
+
+
 # -- compiled-executable introspection ----------------------------------
 
 

@@ -174,6 +174,55 @@ Naming convention (dotted, low cardinality):
   the same (problem, dtype, geometry-fingerprint, config). Read next
   to ``geom.cache.{hits,misses}`` — the same setup-reuse story, one
   level up;
+- the ``krylov`` family — Krylov memory (:mod:`poisson_tpu.krylov`:
+  block-CG batched mode and fingerprint-keyed subspace recycling):
+  ``krylov.cache.hits`` / ``krylov.cache.misses`` — deflation-basis
+  cache lookups (``krylov.recycle``), keyed by (geometry fingerprint,
+  grid box, dtype, scaled, preconditioner): a **miss** runs the
+  harvest-enabled cold solve; a **hit** runs the warm deflated solve
+  against the cached basis. Read next to ``geom.cache.{hits,misses}``
+  — the same fingerprint-reuse story, one tier deeper (canvases make
+  a repeat operator's *setup* cheap; the basis makes its *iterations*
+  cheap); ``krylov.cache.evictions`` — entries LRU-dropped over the
+  byte budget (``KrylovPolicy.budget_bytes``);
+  ``krylov.cache.invalidations`` — entries dropped AUDIBLY for cause
+  (SDC-suspect harvest cohort, divergence/integrity escalation,
+  journal recovery, a failed warm solve — each emits a
+  ``krylov.invalidate``/``krylov.fallback`` event with the reason);
+  ``krylov.harvests`` — converged cold solves whose Lanczos window
+  yielded a cached basis; ``krylov.warm_solves`` — warm deflated
+  solves that converged; ``krylov.iterations_saved`` — net iterations
+  saved by warm solves (Σ of the family's cold count minus the warm
+  count; an unlucky warm solve subtracts honestly);
+  ``krylov.fallbacks`` — warm solves that did NOT converge and fell
+  back to a cold solve (stale/poisoned basis: costs a retry, never a
+  wrong answer — nonzero here with a healthy fleet means bases are
+  going stale faster than they are used);
+  ``krylov.block.solves`` — members dispatched through the block
+  recurrence (``solve_batched(mode="block")``; read next to
+  ``batched.solves`` for the rollout fraction);
+  ``krylov.block.rank_deficient`` — block dispatches whose B×B solves
+  truncated a rank-deficient direction (graceful degradation on
+  near-parallel RHS columns, not a failure; a high ratio to
+  ``krylov.block.solves`` means the traffic's batches are too
+  clustered to benefit from block width);
+- ``serve.krylov.verify_suspensions`` — dispatches where demanded
+  integrity verification (always-on policy stride, or a suspect
+  hardware cohort arming the defensive stride) met a Krylov program
+  that has no verified form yet: the SDC defense WINS — the request
+  falls back to the verified independent/chunked path, the block/
+  deflation acceleration is suspended for that dispatch, and this
+  counter (plus a ``krylov.verify_suspended`` event) is the audible
+  record. Nonzero on a suspect fleet means the ``:blk``/``:defl``
+  cohorts are paying cold verified solves — route the traffic back to
+  independent mode or clear the suspicion;
+- ``serve.krylov.sticky_hits`` / ``serve.krylov.sticky_misses`` —
+  basis-holder routing (the second stickiness axis beside
+  ``serve.fleet.sticky_*``): a deflation-class queue head routed to
+  the worker already holding its fingerprint's basis (hit) or falling
+  back to ordinary routing because the holder is quarantined/dead
+  (miss; only counted for deflation heads with a recorded holder, so
+  the ratio reads as basis-affinity effectiveness);
 - the ``serve.slo`` family — the flight recorder's SLO accounting
   (``obs.flight.SLOTracker``, objectives declared in
   ``serve.types.SLOPolicy``): ``serve.slo.good`` / ``serve.slo.bad``
@@ -211,6 +260,20 @@ counters and numeric gauges in Prometheus text format):
   inverse, 0 when it fell back to smoother sweeps — an audible
   quality bit: the dense coarse solve is what makes the cycle
   resolution-independent);
+- ``cost.krylov.{block_bytes_per_iter,block_flops_per_iter,
+  block_passes_per_member}`` and ``cost.krylov.{deflated_bytes_per_iter,
+  deflated_flops_per_iter,deflated_passes}`` — the analytic block/
+  deflated iteration traffic models (``obs.costs.krylov_block_cost`` /
+  ``krylov_deflated_cost``): what a ``:blk``/``:defl`` cohort's
+  iteration moves, so roofline attribution prices the
+  fewer-iterations-for-more-bytes-per-iteration trade instead of
+  averaging it away;
+- ``serve.krylov.{cold_p50_seconds,warm_p50_seconds,cold_p99_seconds,
+  warm_p99_seconds}`` — the repeat-fingerprint open-loop bench's
+  cold-vs-warm latency split (``bench.py --serve --repeat-fingerprint``;
+  cold = the family's first request, warm = repeats against the cached
+  basis), stamped per run so the forensics report can render the
+  warm-start win beside the ``krylov.*`` counters;
 - ``roofline.{achieved_gbps,peak_gbps,fraction}`` — measured throughput
   against the platform bandwidth ceiling;
 - ``export.http_port`` — the live ``/metrics`` endpoint's bound port;

@@ -45,7 +45,11 @@ Prometheus exposition. Step 19 runs the program-contract gate
 drift over the checkout (zero unsuppressed findings), the HLO identity
 ledger against the committed fingerprints (every flag-off program
 structurally clean and byte-stable), and the ``contracts_*`` gauges
-surviving exposition.
+surviving exposition. Step 20 (runs LAST of all, clean registry)
+proves the Krylov memory (``poisson_tpu.krylov``): a cold solve
+harvests a deflation basis, the warm solve of the same operator
+converges in strictly fewer iterations off the cache, and the
+``krylov_*`` counters survive Prometheus exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -671,6 +675,54 @@ def run_selfcheck(out_dir: str) -> int:
     if contracts_parsed["poisson_tpu_contracts_findings"]["value"] != 0:
         return _fail("contracts.findings gauge nonzero after a clean run")
 
+    # 20. Krylov memory end to end (runs LAST, clean registry): a cold
+    # solve against a fresh fingerprint harvests a deflation basis
+    # (krylov.cache.misses + krylov.harvests), the warm solve of the
+    # SAME operator at a different RHS gate converges in strictly fewer
+    # iterations off the cached basis (krylov.cache.hits +
+    # krylov.warm_solves + iterations_saved), and the krylov_* counters
+    # survive the Prometheus exposition round trip.
+    from poisson_tpu.krylov import KrylovPolicy
+    from poisson_tpu.krylov.recycle import (
+        reset_krylov_cache,
+        solve_recycled,
+    )
+
+    obs_metrics.reset()
+    reset_krylov_cache()
+    kp20 = KrylovPolicy(deflation=True)
+    cold20 = solve_recycled(problem, dtype="float32", policy=kp20)
+    warm20 = solve_recycled(problem, dtype="float32", policy=kp20,
+                            rhs_gate=1.4)
+    if int(cold20.flag) != 1 or int(warm20.flag) != 1:
+        return _fail(f"krylov solves did not converge: cold flag "
+                     f"{int(cold20.flag)}, warm flag {int(warm20.flag)}")
+    if int(warm20.iterations) >= int(cold20.iterations):
+        return _fail(
+            f"warm start did not beat cold: warm "
+            f"{int(warm20.iterations)} vs cold {int(cold20.iterations)}")
+    if (obs_metrics.get("krylov.cache.misses") != 1
+            or obs_metrics.get("krylov.cache.hits") != 1
+            or obs_metrics.get("krylov.harvests") != 1
+            or obs_metrics.get("krylov.warm_solves") != 1):
+        return _fail(
+            f"krylov cache arithmetic off: "
+            f"misses={obs_metrics.get('krylov.cache.misses')}, "
+            f"hits={obs_metrics.get('krylov.cache.hits')}, "
+            f"harvests={obs_metrics.get('krylov.harvests')}, "
+            f"warm={obs_metrics.get('krylov.warm_solves')}")
+    saved20 = obs_metrics.get("krylov.iterations_saved")
+    if saved20 < 1:
+        return _fail(f"krylov.iterations_saved not positive: {saved20}")
+    krylov_parsed = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_krylov_cache_hits",
+                      "poisson_tpu_krylov_cache_misses",
+                      "poisson_tpu_krylov_harvests",
+                      "poisson_tpu_krylov_warm_solves",
+                      "poisson_tpu_krylov_iterations_saved"):
+        if prom_name not in krylov_parsed:
+            return _fail(f"exposition lost the {prom_name} counter")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -691,7 +743,9 @@ def run_selfcheck(out_dir: str) -> int:
           f"device loss -> {int(rebinds)} rebind, 0 lost), program "
           f"contracts ok ({contracts_report['counts']['rules']} rules, "
           f"{contracts_report['counts']['ledger_programs']} ledger "
-          f"programs, 0 findings) "
+          f"programs, 0 findings), krylov memory ok "
+          f"(cold {int(cold20.iterations)} -> warm "
+          f"{int(warm20.iterations)} it, {int(saved20)} saved) "
           f"({out_dir})")
     return 0
 

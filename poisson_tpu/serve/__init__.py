@@ -72,6 +72,7 @@ from poisson_tpu.serve.service import (
     slowest_requests,
 )
 from poisson_tpu.integrity.probe import IntegrityPolicy
+from poisson_tpu.krylov import KrylovPolicy
 from poisson_tpu.serve.types import (
     ERROR_DIVERGENCE,
     ERROR_INTEGRITY,
@@ -103,7 +104,7 @@ __all__ = [
     "ERROR_DIVERGENCE", "ERROR_INTEGRITY",
     "ERROR_INTERNAL", "ERROR_PLACEMENT",
     "ERROR_TRANSIENT", "FleetPolicy", "HALF_OPEN", "IntegrityPolicy",
-    "JournalReplay",
+    "JournalReplay", "KrylovPolicy",
     "OPEN", "Outcome", "OUTCOME_ERROR",
     "OUTCOME_RESULT", "OUTCOME_SHED", "PendingRequest", "Placement",
     "PlacementError", "RetryPolicy",

@@ -117,6 +117,15 @@ class SolveJournal:
             # unreconstructable — audibly torn, never silently solved
             # as the wrong domain).
             req["geometry"] = request.geometry.to_json()
+        if request.krylov is not None:
+            # The request-level Krylov knobs replay too: a recovered
+            # block/deflation request must re-dispatch through the SAME
+            # cohort and program family it was admitted into (the basis
+            # itself is never journaled — device state rebuilds,
+            # poisson_tpu.krylov.recycle).
+            import dataclasses as _dc
+
+            req["krylov"] = _dc.asdict(request.krylov)
         self.record(
             "submit", request_id=str(request.request_id),
             trace_id=trace_id,
@@ -307,6 +316,13 @@ def replay_journal(path: str) -> JournalReplay:
                 # JSON) and fall into the unreconstructable branch —
                 # audible, never the wrong domain.
                 req_fields["geometry"] = parse_geometry(geo_json)
+            krylov_d = req_fields.pop("krylov", None)
+            if krylov_d:
+                from poisson_tpu.krylov import KrylovPolicy
+
+                # Unknown keys (a future policy field) raise TypeError
+                # into the unreconstructable branch — audible.
+                req_fields["krylov"] = KrylovPolicy(**krylov_d)
             request = SolveRequest(request_id=rid, problem=problem,
                                    **req_fields)
         except (KeyError, TypeError, ValueError) as e:

@@ -94,6 +94,7 @@ from poisson_tpu.serve.fleet import (
     WorkerPool,
 )
 from poisson_tpu.serve.placement import PlacementError
+from poisson_tpu.krylov import DEFAULT_KRYLOV as DEFAULT_KRYLOV_POLICY
 from poisson_tpu.serve.types import (
     ERROR_DIVERGENCE,
     ERROR_INTEGRITY,
@@ -249,6 +250,13 @@ class SolveService:
         # when the policy default is off — a core that miscomputed once
         # is the textbook mercurial core (Hochschild et al. 2021).
         self._suspect_hw: set = set()
+        # Basis-holder stickiness (poisson_tpu.krylov.recycle): which
+        # worker last harvested/used each geometry fingerprint's
+        # deflation basis. Routing prefers the holder for
+        # deflation-class heads (serve.krylov.sticky_{hits,misses}) —
+        # the second stickiness axis beside bucket executables: on a
+        # real fleet the basis lives in the holder's device memory.
+        self._basis_holder: dict = {}
         # The worker pool: N dispatch contexts over this one queue and
         # ledger (serve.fleet; workers=1 is the classic single-worker
         # service — same scheduling decisions, same golden outcomes).
@@ -310,6 +318,32 @@ class SolveService:
 
             resolve_preconditioner(pre)
             validate_mg_problem(request.problem)
+        # Krylov-memory validation, same loud-at-admission contract: an
+        # unknown mode / block+deflation never enters the queue, and
+        # the uncomposable combinations are caller bugs, not dispatch
+        # surprises.
+        kp = self._krylov(request)
+        if kp != DEFAULT_KRYLOV_POLICY:
+            from poisson_tpu.krylov import resolve_krylov
+
+            resolve_krylov(kp)
+            if kp.mode == "block" and pre not in (None, "jacobi"):
+                raise ValueError(
+                    "krylov mode='block' composes with the jacobi body "
+                    f"only (preconditioner={pre!r} has no block "
+                    "program)")
+            if kp.deflation:
+                if pre not in (None, "jacobi"):
+                    raise ValueError(
+                        "krylov deflation composes with the jacobi "
+                        f"body only (preconditioner={pre!r} has no "
+                        "deflated program)")
+                if (request.deadline_seconds is not None
+                        or request.chunk is not None):
+                    raise ValueError(
+                        "krylov deflation does not ride the chunked/"
+                        "deadline path yet — drop deadline_seconds/"
+                        "chunk or deflation")
         # A placement pin outside the fleet topology — or to a healthy
         # device no worker is bound to (the pin could never be served)
         # — is a caller bug, loud at admission (same contract as a
@@ -400,7 +434,8 @@ class SolveService:
             if worker is None:
                 return verdict       # head errored typed / waited out
         else:
-            worker = self._pool.next_worker(self._head_cohort())
+            worker = (self._basis_sticky_worker()
+                      or self._pool.next_worker(self._head_cohort()))
         if worker is None:
             return self._no_worker_step()
         # Beat only when the step has work: the beat marks the step's
@@ -472,6 +507,30 @@ class SolveService:
                     f"no live worker bound to pinned device {pin} "
                     f"({len(bound)} bound)")
         return (None, True)
+
+    def _basis_sticky_worker(self):
+        """Soft routing preference for deflation-class heads: the
+        worker that last held this fingerprint's basis, when it is
+        still RUNNING (serve.krylov.sticky_hits); otherwise ordinary
+        routing applies (serve.krylov.sticky_misses — counted only for
+        deflation heads with a recorded holder, so the ratio reads as
+        basis-affinity effectiveness, not as generic routing traffic).
+        None: not a deflation head, or no preference."""
+        if not self._queue:
+            return None
+        head = self._queue[0]
+        if not self._krylov(head.request).deflation:
+            return None
+        holder = self._basis_holder.get(
+            fingerprint_of(head.request.geometry))
+        if holder is None:
+            return None
+        for w in self._pool.workers:
+            if w.id == holder and w.state == WORKER_RUNNING:
+                obs.inc("serve.krylov.sticky_hits")
+                return w
+        obs.inc("serve.krylov.sticky_misses")
+        return None
 
     def _restart_due_workers(self) -> None:
         for worker in self._pool.release_due():
@@ -758,6 +817,27 @@ class SolveService:
         the service default."""
         return request.preconditioner or self.policy.preconditioner
 
+    def _krylov(self, request: SolveRequest):
+        """The request's effective Krylov-memory policy
+        (:mod:`poisson_tpu.krylov`): its own knob, else the service
+        default."""
+        return request.krylov or self.policy.krylov
+
+    def _krylov_marker(self, request: SolveRequest) -> str:
+        """The cohort suffix the Krylov policy contributes: ``:blk``
+        (block bucket executables) / ``:defl`` (deflated solo
+        dispatch) split executables, breakers, and — downstream —
+        sentinel baselines, exactly like the ``:mg`` marker: a block
+        or deflated rollout never indicts the independent fleet, and
+        vice versa. The default policy contributes nothing — historical
+        cohort strings byte-for-byte."""
+        kp = self._krylov(request)
+        if kp.mode == "block":
+            return ":blk"
+        if kp.deflation:
+            return ":defl"
+        return ""
+
     def _cohort(self, request: SolveRequest) -> str:
         p = request.problem
         base = f"{p.M}x{p.N}:{request.dtype or 'auto'}:xla"
@@ -768,11 +848,16 @@ class SolveService:
         # never indicts the Jacobi fleet, and vice versa.
         if self._precond(request) == "mg":
             base += ":mg"
+        base += self._krylov_marker(request)
         # Geometry requests form their own cohorts — the executable
         # family differs (stacked canvases) — but the FINGERPRINT stays
         # out of the key: different geometries on the same grid share
         # the cohort, the bucket executable, and the breaker, which is
-        # the mixed-geometry co-batching seam.
+        # the mixed-geometry co-batching seam. (Block cohorts are the
+        # one exception to fingerprint-blind batch FORMATION — the
+        # block recurrence needs one shared operator, so _form_batch
+        # additionally requires fingerprint uniformity there — but the
+        # cohort string still never carries the fingerprint.)
         return base + (":geo" if request.geometry is not None else "")
 
     def _hw_cohort(self) -> tuple:
@@ -832,6 +917,12 @@ class SolveService:
             obs.event("serve.integrity.suspect_cohort",
                       backend=cohort[0], device_kind=cohort[1],
                       device=cohort[2])
+            # A deflation basis harvested on a flip-suspect part is not
+            # evidence: drop it so warm solves rebuild on trusted
+            # silicon (krylov.cache.invalidations, audible).
+            from poisson_tpu.krylov.recycle import invalidate
+
+            invalidate(hw=cohort, reason="sdc-suspect-cohort")
 
     def _breaker(self, worker: Worker, cohort: str) -> CircuitBreaker:
         """The ``worker``'s breaker for ``cohort``: breaker state is
@@ -846,6 +937,8 @@ class SolveService:
         """Chunked single-request dispatch classes: deadline-carrying
         (expiry needs chunk boundaries), explicitly chunked, escalated
         divergence retries (the resilient driver is single-request),
+        deflation-enabled requests (the fingerprint-keyed solver
+        memory is a single-request program — ``krylov.recycle``),
         MG+geometry requests (per-member hierarchies do not co-batch —
         ``solvers.batched`` rejects the combination loudly, so the
         service routes it through the chunked solo path instead), or
@@ -856,6 +949,7 @@ class SolveService:
                 or entry.request.chunk is not None
                 or entry.escalate
                 or entry.request.device_id is not None
+                or self._krylov(entry.request).deflation
                 or (entry.request.geometry is not None
                     and self._precond(entry.request) == "mg"))
 
@@ -863,13 +957,19 @@ class SolveService:
         if self._solo(head):
             return [head]
         cohort = self._cohort(head.request)
+        # Block cohorts batch one OPERATOR: the block recurrence is
+        # only defined for a shared A, so candidates must match the
+        # head's geometry fingerprint exactly (the one deliberate
+        # exception to fingerprint-blind batch formation).
+        block = self._krylov(head.request).mode == "block"
+        head_fp = fingerprint_of(head.request.geometry)
         batch = [head]
         ids = {head.request.request_id}
         taints = set(head.taint)
         # Fingerprint-keyed exclusion, both directions: the batch's
         # accumulated geometry fingerprints vs the candidate's taint
         # list, and the candidate's fingerprint vs the batch's.
-        fps = {fingerprint_of(head.request.geometry)}
+        fps = {head_fp}
         taint_fps = set(head.taint_fp)
         kept = deque()
         while self._queue and len(batch) < self.policy.max_batch:
@@ -878,6 +978,7 @@ class SolveService:
             compatible = (
                 not self._solo(e)
                 and self._cohort(e.request) == cohort
+                and (not block or e_fp == head_fp)
                 and e.request.request_id not in taints
                 and not (ids & e.taint)
                 and e_fp not in taint_fps
@@ -922,10 +1023,15 @@ class SolveService:
         """Continuous mode: deadline-carrying requests ride lanes (the
         engine's chunk boundary IS the deadline check), so only
         explicitly-chunked requests, escalated divergence retries (the
-        resilient driver is single-request), and MG+geometry requests
-        (per-lane hierarchies do not exist yet) still dispatch solo."""
+        resilient driver is single-request), Krylov-memory requests
+        (the block recurrence couples members — it cannot step
+        per-lane; deflation is a single-request program), and
+        MG+geometry requests (per-lane hierarchies do not exist yet)
+        still dispatch through the drain-mode machinery."""
+        kp = self._krylov(entry.request)
         return (entry.request.chunk is None and not entry.escalate
                 and entry.request.device_id is None
+                and kp.mode == "independent" and not kp.deflation
                 and not (entry.request.geometry is not None
                          and self._precond(entry.request) == "mg"))
 
@@ -943,6 +1049,7 @@ class SolveService:
         base = f"{p.M}x{p.N}:{self._effective_dtype(entry, level)}:xla"
         if self._precond(entry.request) == "mg":
             base += ":mg"
+        base += self._krylov_marker(entry.request)
         # Same rule as _cohort: the :geo marker splits executables, the
         # fingerprint never does — mixed geometries share the lane table.
         return base + (":geo" if entry.request.geometry is not None
@@ -990,6 +1097,15 @@ class SolveService:
             self._shed(head, SHED_BREAKER_OPEN,
                        f"circuit breaker open for cohort "
                        f"{self._cohort(head.request)}")
+            return True
+        # A block-mode head is lane-ineligible (the recurrence couples
+        # members) but NOT solo: it still wants its cohort co-batched,
+        # so the continuous engine borrows drain-mode batch formation
+        # for it between chunk steps.
+        if (self._krylov(head.request).mode == "block"
+                and not self._solo(head)):
+            self._dispatch(worker, self._form_batch(head), breaker,
+                           level)
             return True
         self._dispatch(worker, [head], breaker, level)
         return True
@@ -1410,20 +1526,44 @@ class SolveService:
         # fingerprints share the one stacked-canvas bucket executable.
         geoms = [e.request.geometry for e in batch]
         verify_every, verify_tol = self._verify_params(batch)
-        self._count_defensive_verify(verify_every)
         # The batch is cohort-homogeneous (the :mg marker splits
         # cohorts), so the head's preconditioner is everyone's.
+        # The batch is cohort-homogeneous in its Krylov mode too (the
+        # :blk marker splits cohorts), so the head's mode is everyone's.
+        kp = self._krylov(batch[0].request)
+        if kp.mode == "block" and verify_every > 0:
+            # The block recurrence has no per-member integrity probe
+            # yet: when verification is demanded (always-on policy, or
+            # a suspect cohort arming the defensive stride), the SDC
+            # defense WINS — the batch dispatches through the VERIFIED
+            # independent program instead (same members, same typed
+            # outcomes, block acceleration suspended audibly). A
+            # silent unverified block dispatch would bypass the PR 10
+            # defense; passing the stride through would ValueError
+            # into a non-retried internal error for every member.
+            obs.inc("serve.krylov.verify_suspensions")
+            obs.event("krylov.verify_suspended", mode="block",
+                      batch=len(batch), verify_every=verify_every)
+            kp = DEFAULT_KRYLOV_POLICY
+        self._count_defensive_verify(verify_every)
         result = solve_batched(
             problem,
             rhs_gates=[e.request.rhs_gate for e in batch],
             member_ids=[e.request.request_id for e in batch],
             dtype=dtype,
-            bucket=(len(batch) if exact_bucket else None),
+            bucket=(len(batch) if exact_bucket and kp.mode != "block"
+                    else None),
             geometries=(geoms if any(g is not None for g in geoms)
                         else None),
             verify_every=verify_every, verify_tol=verify_tol,
             preconditioner=self._precond(batch[0].request),
+            mode=kp.mode,
         )
+        if result.deficient is not None and bool(
+                np.asarray(result.deficient)):
+            # Graceful rank degradation inside the block recurrence —
+            # audible, not a failure (near-parallel RHS columns).
+            obs.inc("krylov.block.rank_deficient")
         co_ids = {e.request.request_id for e in batch}
         co_fps = _geo_fps(batch)
         iters = np.asarray(result.iterations)
@@ -1476,6 +1616,48 @@ class SolveService:
         rid = req.request_id
         verify_every, verify_tol = self._verify_params([entry])
         self._count_defensive_verify(verify_every)
+        kp = self._krylov(req)
+        if (kp.deflation and not entry.escalate
+                and verify_every > 0):
+            # The deflated program has no in-loop integrity probe yet:
+            # when verification is demanded (always-on policy, or a
+            # suspect hardware cohort arming the defensive stride),
+            # the SDC defense WINS — the request falls through to the
+            # verified chunked path below (cold, correct, defended)
+            # and the suspension is audible. Silently running the
+            # unverified warm program on flip-suspect silicon would
+            # bypass the PR 10 defense for the whole :defl cohort.
+            obs.inc("serve.krylov.verify_suspensions")
+            obs.event("krylov.verify_suspended",
+                      request_id=str(rid), mode="deflation",
+                      verify_every=verify_every)
+        elif kp.deflation and not entry.escalate:
+            from poisson_tpu.geometry.dsl import fingerprint_of
+            from poisson_tpu.krylov.recycle import solve_recycled
+
+            # The fingerprint-keyed solver memory: warm solves deflate
+            # against the cached basis, cold solves harvest one. The
+            # dispatching worker becomes the family's basis holder —
+            # the second stickiness axis routing prefers (see pump()).
+            result = solve_recycled(
+                problem, dtype=dtype, rhs_gate=req.rhs_gate,
+                geometry=req.geometry, policy=kp,
+                hw=self._hw_cohort(),
+            )
+            worker = self._active_worker
+            if worker is not None:
+                self._basis_holder[fingerprint_of(req.geometry)] = \
+                    worker.id
+            secs = max(0.0, self._clock() - t_disp)
+            iters = int(result.iterations)
+            self._flight.add_step(rid, secs, iters,
+                                  secs if iters else 0.0, did, k=iters)
+            self._flight.end(rid, SPAN_RESIDENT, iterations=iters)
+            return self._classify_member(
+                entry, int(result.flag), iters,
+                float(np.max(np.asarray(result.diff))),
+                restarts=0, cap=problem.iteration_cap, co_ids=set(),
+            )
         if entry.escalate and self.policy.retry.escalate_divergence:
             obs.inc("serve.escalations")
             try:
@@ -1630,6 +1812,18 @@ class SolveService:
                                          ERROR_INTEGRITY)
                           and self.policy.retry.escalate_divergence
                           and entry.request.geometry is None)
+        # A deflation-class request whose solve went divergence/
+        # integrity-bad implicates its cached basis: invalidate the
+        # family so the retry (escalated or not) runs cold and
+        # re-harvests on success — stale memory costs a rebuild, never
+        # a second poisoned dispatch.
+        if (self._krylov(entry.request).deflation
+                and error_type in (ERROR_DIVERGENCE, ERROR_INTEGRITY)):
+            from poisson_tpu.krylov.recycle import invalidate
+
+            invalidate(
+                fingerprint=fingerprint_of(entry.request.geometry),
+                reason=f"escalation-{error_type}")
         entry.not_before = self._clock() + delay
         obs.inc("serve.retries")
         if error_type == ERROR_INTEGRITY:
@@ -1770,8 +1964,16 @@ class SolveService:
         replayed or retried submission can never double-admit), and
         keep journaling into the same file. The replay report rides on
         the returned service as ``.recovery``."""
+        from poisson_tpu.krylov.recycle import invalidate
         from poisson_tpu.serve.journal import replay_journal
 
+        # Journal-safe solver memory: bases live in device memory and
+        # are NEVER journaled, so a recovered process must rebuild
+        # them from fresh cold solves rather than trust whatever an
+        # earlier life (or a same-process predecessor service) left in
+        # the process-global cache — unreplayed device state is not
+        # evidence. Audible (krylov.cache.invalidations).
+        invalidate(all_entries=True, reason="journal-recovery")
         replay = replay_journal(journal.path)
         svc = cls(policy, journal=journal, **kwargs)
         svc._absorb_replay(replay)

@@ -37,6 +37,7 @@ from typing import Callable, Optional, Union
 
 from poisson_tpu.config import Problem
 from poisson_tpu.integrity.probe import IntegrityPolicy
+from poisson_tpu.krylov import KrylovPolicy
 
 OUTCOME_RESULT = "result"
 OUTCOME_ERROR = "error"
@@ -112,6 +113,18 @@ class SolveRequest:
     # at journal recovery on a smaller topology) becomes a typed
     # ``placement`` error, never a wedge. None (default): any worker.
     device_id: Optional[int] = None
+    # Krylov-memory knobs (:mod:`poisson_tpu.krylov`; None defers to
+    # ``ServicePolicy.krylov``). ``mode="block"`` requests form their
+    # own ``…:blk`` cohorts (block bucket executables — co-batched
+    # members must share one operator, so block batches additionally
+    # require fingerprint-uniform geometry); ``deflation=True``
+    # requests form ``…:defl`` cohorts and dispatch solo through the
+    # fingerprint-keyed basis cache (``krylov.recycle``), with routing
+    # preferring the worker already holding the family's basis.
+    # Validated at admission: an unknown mode, block+deflation, or
+    # deflation combined with the chunked/deadline path is a loud
+    # ValueError.
+    krylov: Optional[KrylovPolicy] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -331,6 +344,15 @@ class ServicePolicy:
     executables; ``"mg"`` makes the V-cycle the fleet default —
     requests on uncoarsenable grids are then rejected loudly at
     submission rather than failing inside a dispatch).
+
+    ``krylov`` is the service-wide Krylov-memory default
+    (:class:`~poisson_tpu.krylov.KrylovPolicy`) for requests that do
+    not set their own: the default (independent mode, no deflation)
+    keeps every prior release's executables and cohorts byte-for-byte;
+    ``mode="block"`` makes the block recurrence the fleet default for
+    batchable dispatches (``…:blk`` cohorts), ``deflation=True`` routes
+    every request through the fingerprint-keyed solver memory
+    (``…:defl`` cohorts, solo dispatch, basis-holder sticky routing).
     """
 
     capacity: int = 64
@@ -346,3 +368,4 @@ class ServicePolicy:
     slo: SLOPolicy = SLOPolicy()
     fleet: FleetPolicy = FleetPolicy()
     integrity: IntegrityPolicy = IntegrityPolicy()
+    krylov: KrylovPolicy = KrylovPolicy()

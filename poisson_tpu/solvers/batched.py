@@ -346,7 +346,8 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
                   verify_every: int = 0,
                   verify_tol=None,
                   preconditioner: str = "jacobi",
-                  mg_config=None) -> PCGResult:
+                  mg_config=None,
+                  mode: str = "independent") -> PCGResult:
     """Solve a batch of Poisson problems in one fused device program.
 
     Input forms (exactly one):
@@ -416,6 +417,28 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     verified buckets form their own bucket-cache key family and
     ``verify_every=0`` keeps the historical executables byte-for-byte.
 
+    ``mode`` selects the batched recurrence (``poisson_tpu.krylov``):
+    ``"independent"`` (the default) is the historical vmapped-member
+    program — byte-identical executables, golden counts bit-for-bit;
+    ``"block"`` carries the (n × B) block iterate with B×B recurrences
+    (:mod:`poisson_tpu.krylov.block` — breakdown-free block CG), so
+    members share spectral information and total iterations drop on
+    clustered RHS batches. Block mode requires ONE shared operator:
+    ``geometries`` entries, if given, must all carry the same
+    fingerprint (the single shared domain); ``mesh``/MG/``verify_every``
+    have no block program yet and are rejected loudly. Block dispatches
+    compile at the EXACT batch size (no zero-RHS padding — a zero
+    column is pure rank deficiency, wasted width by construction) and
+    their bucket-cache keys carry a ``("block",)`` marker so block
+    executables never claim reuse of the independent family. Block
+    iteration counts are per-member first-δ-crossings of a coupled
+    recurrence — NOT comparable to the independent mode's — so block
+    mode is gated by the manufactured-solution L2 oracle
+    (``geometry.manufactured.manufactured_error(krylov=…)``), not by
+    golden-count parity. ``PCGResult.deficient`` reports whether the
+    B×B solves truncated a rank-deficient direction (graceful
+    degradation — the ``krylov.block.rank_deficient`` counter).
+
     ``preconditioner="mg"`` runs every member with the geometric
     V-cycle preconditioner (:mod:`poisson_tpu.mg`): the shared member
     body — V-cycle inside ``apply_Dinv`` — is vmapped exactly like the
@@ -433,6 +456,29 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     need its own level hierarchy — and are rejected loudly (the solve
     service dispatches geometry+MG requests solo).
     """
+    from poisson_tpu.krylov import KRYLOV_BLOCK, KRYLOV_MODES
+
+    if mode not in KRYLOV_MODES:
+        raise ValueError(
+            f"unknown mode {mode!r} — expected one of {KRYLOV_MODES}")
+    use_block = mode == KRYLOV_BLOCK
+    if use_block:
+        # The block recurrence couples members through B×B solves, so
+        # it is only defined for ONE shared operator; the orthogonal
+        # executable families have no block program yet:
+        if mesh is not None:
+            raise ValueError(
+                "mode='block' has no sharded program yet; drop mesh= "
+                "or use mode='independent'")
+        if preconditioner not in (None, "jacobi"):
+            raise ValueError(
+                "mode='block' composes with the jacobi (symmetric-"
+                f"scaling) body only; preconditioner={preconditioner!r} "
+                "has no block program — use mode='independent'")
+        if int(verify_every) > 0:
+            raise ValueError(
+                "mode='block' does not trace the per-member integrity "
+                "probe yet; run verify_every=0 or mode='independent'")
     if mesh is not None:
         # The batch×mesh composition (vmap outside shard_map — members
         # stay whole-grid, the mesh splits the grid) is wired for the
@@ -509,6 +555,16 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
 
         geo = [None if g is None else parse_geometry(g)
                for g in geometries]
+        if use_block:
+            from poisson_tpu.geometry.dsl import fingerprint_of
+
+            fps = {fingerprint_of(g) for g in geo}
+            if len(fps) != 1:
+                raise ValueError(
+                    "mode='block' needs ONE shared operator: every "
+                    "geometries entry must carry the same fingerprint "
+                    f"(got {len(fps)} distinct domains) — mixed-domain "
+                    "batches use mode='independent'")
 
     def _geo_setups(base_problem, n, per_member_problems=None):
         """One (a, b, rhs, aux) per member — fingerprint-cached device
@@ -603,7 +659,18 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     else:
         origin = tuple(range(batch))
 
-    size = bucket_size(batch, buckets) if bucket is None else int(bucket)
+    if use_block:
+        # Block dispatches compile at the EXACT batch size: a zero-RHS
+        # padding column is pure rank deficiency — width the coupled
+        # recurrence would pay for and truncate every iteration.
+        if bucket is not None and int(bucket) != batch:
+            raise ValueError(
+                f"mode='block' dispatches exact-size blocks; bucket="
+                f"{bucket} cannot pad a batch of {batch}")
+        size = batch
+    else:
+        size = (bucket_size(batch, buckets) if bucket is None
+                else int(bucket))
     if size < batch:
         raise ValueError(f"bucket {size} smaller than batch {batch}")
     if size > batch:
@@ -628,6 +695,22 @@ def solve_batched(problems=None, *, rhs_stack=None, rhs_gates=None,
     # flag-off key keeps its historical shape and counter arithmetic.
     verify_key = (("verify", verify_every, v_tol)
                   if verify_every > 0 else None)
+    if use_block:
+        from poisson_tpu.krylov.block import _solve_block
+
+        if geo is not None:
+            # One shared domain (fingerprint-uniform, validated above):
+            # the block runs on its canvases, unbatched — the shared
+            # operator is the whole point.
+            a, b, aux = setups[0][0], setups[0][1], setups[0][3]
+        key = (size, jit_problem, dtype_name, use_scaled, ("block",))
+        if geo is not None:
+            key = key + ("geo",)
+        _count_bucket(key, batch, size)
+        obs.inc("krylov.block.solves", batch)
+        result = _solve_block(jit_problem, use_scaled, a, b, rhs_stack,
+                              aux)
+        return result._replace(origin=origin)
     if mesh is not None:
         from poisson_tpu.parallel.mesh import X_AXIS, Y_AXIS, block_size
         from poisson_tpu.parallel.pcg_sharded import (

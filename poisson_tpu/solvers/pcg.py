@@ -151,6 +151,12 @@ class PCGResult(NamedTuple):
     # a member re-enqueued into a different bucket keeps its identity.
     # Host-side metadata (ints/strings, not traced arrays).
     origin: object = None
+    # Block-mode solves only (poisson_tpu.krylov.block): scalar bool —
+    # the B×B coefficient solves truncated a rank-deficient direction
+    # at some iteration (graceful degradation, not a failure; the
+    # service counts it as ``krylov.block.rank_deficient``). None (an
+    # empty pytree node) on every other solver's results.
+    deficient: object = None
 
 
 def iterations_scalar(iterations) -> int:

@@ -101,6 +101,11 @@ recover-on-smaller-topology journal recovery on a SMALLER topology:
                             remapped audibly, a pinned request whose
                             device is gone gets a typed ``placement``
                             error, the merged ledger closes
+deflation-stale-basis       a poisoned/evicted deflation basis makes
+                            warm requests fall back to a cold solve
+                            with a typed audible event — never a wrong
+                            answer — and the rebuilt basis serves the
+                            tail warm again
 ==========================  ============================================
 
 Every scenario resets the metrics registry, runs against a
@@ -191,12 +196,14 @@ def _quiet_degradation():
 
 def _reset_registries() -> None:
     from poisson_tpu.geometry.canvas import reset_geometry_cache
+    from poisson_tpu.krylov.recycle import reset_krylov_cache
     from poisson_tpu.obs import metrics
     from poisson_tpu.solvers.batched import reset_bucket_cache
 
     metrics.reset()
     reset_bucket_cache()
     reset_geometry_cache()
+    reset_krylov_cache()
 
 
 def _finish(name: str, seed: int, checks: dict, detail: dict) -> dict:
@@ -1642,6 +1649,90 @@ def _recover_on_smaller_topology(seed: int) -> dict:
     }, {"in_flight_devices": [pend.device_id for pend in in_flight],
         "outcomes": {str(k): v.kind for k, v in outs.items()},
         "recovered": stats_b["recovered"]})
+
+
+@scenario("deflation-stale-basis", group="krylov")
+def _deflation_stale_basis(seed: int) -> dict:
+    """Solver memory gone stale (``poisson_tpu.krylov.recycle``): the
+    cached deflation basis for a repeat fingerprint F is POISONED
+    mid-run (NaN overwrite — the silent-staleness shape) and later
+    EVICTED outright. Warm requests against F must fall back to a cold
+    solve with a typed audible event (``krylov.fallbacks`` +
+    ``krylov.invalidate``), never a wrong answer: every outcome is a
+    converged result whose iterate the deflated recurrence maintained
+    against the TRUE operator, and the rebuilt basis serves the tail of
+    the traffic warm again. The ledger invariant closes from the
+    emitted snapshot like every scenario."""
+    from poisson_tpu.geometry import Ellipse
+    from poisson_tpu.krylov import KrylovPolicy
+    from poisson_tpu.krylov.recycle import (
+        cache_stats,
+        has_basis,
+        invalidate,
+        poison_basis,
+    )
+    from poisson_tpu.serve import (
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+
+    fam = Ellipse(cx=0.12, cy=-0.04, rx=0.62, ry=0.33)   # fingerprint F
+    kp = KrylovPolicy(deflation=True)
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.01,
+                              backoff_cap=0.05),
+            degradation=_quiet_degradation(),
+            krylov=kp,
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    p = _problem()
+
+    def run(rid, gate):
+        svc.submit(SolveRequest(request_id=rid, problem=p, geometry=fam,
+                                rhs_gate=gate))
+        return svc.drain()[-1]
+
+    cold = run("cold", 1.0)                 # miss → harvest
+    warm = run("warm", 1.3)                 # hit → deflated warm solve
+    harvested = has_basis(p, geometry=fam, policy=kp)
+    warm_won = warm.iterations < cold.iterations
+
+    poisoned = poison_basis()               # NaN the cached basis
+    after_poison = run("stale", 0.8)        # warm attempt → fallback
+    fallback_fired = _counter("krylov.fallbacks") >= 1
+    rebuilt = has_basis(p, geometry=fam, policy=kp)
+    rewarm = run("rewarm", 1.1)             # rebuilt basis serves warm
+
+    evicted = invalidate(fingerprint=fam.fingerprint,
+                         reason="chaos-eviction")
+    after_evict = run("evicted", 1.2)       # cold again, audibly
+    tail = run("tail", 0.9)                 # … and warm again
+
+    outs = [cold, warm, after_poison, rewarm, after_evict, tail]
+    return _finish("deflation-stale-basis", seed, {
+        "cold_solve_harvested_a_basis": harvested
+        and _counter("krylov.harvests") >= 1,
+        "warm_start_beat_cold": bool(warm_won),
+        "poisoned_basis_fell_back_audibly": poisoned == 1
+        and fallback_fired
+        and _counter("krylov.cache.invalidations") >= 1,
+        "fallback_rebuilt_the_basis": rebuilt
+        and rewarm.iterations < cold.iterations,
+        "eviction_fell_back_to_cold": evicted == 1
+        and after_evict.iterations >= cold.iterations - 2,
+        "tail_served_warm_again": tail.iterations < cold.iterations,
+        "never_a_wrong_answer": all(
+            o.kind == "result" and o.converged for o in outs),
+        "ledger_closed": svc.stats()["lost"] == 0,
+    }, {"iterations": {o.request_id: o.iterations for o in outs},
+        "cache": cache_stats(),
+        "iterations_saved": _counter("krylov.iterations_saved")})
 
 
 # -- campaign runner ----------------------------------------------------

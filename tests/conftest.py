@@ -122,6 +122,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "krylov: Krylov-memory suite (block-CG batched mode incl. the "
+        "default-path byte pin and rank-deficiency handling, "
+        "deflation-basis harvest/cache/warm-start, per-family L2 "
+        "floors, serve cohort splits, stale-basis chaos, sentinel "
+        "pins; CPU-fast; runs in tier-1, selectable with -m krylov)",
+    )
+    config.addinivalue_line(
+        "markers",
         "mg: geometric-multigrid preconditioning suite "
         "(default-jacobi-path HLO/golden pins, two-grid convergence "
         "factor, V-cycle apply bit-parity under vmap, per-family "

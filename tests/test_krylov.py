@@ -1,0 +1,583 @@
+"""Krylov-memory suite: block-CG batched mode + fingerprint recycling.
+
+Covers the PR 14 contracts end to end:
+
+- the default path is untouched — ``solve_batched(mode="independent")``
+  is bit-identical to the historical call and the committed ledger pins
+  its lowering to the SAME fingerprint as ``batched.mesh_none_f64``;
+- block mode converges every geometry family at its manufactured-
+  solution L2 floor, cuts total iterations on a clustered batch, and
+  degrades gracefully (never breaks down) on rank-deficient batches;
+- deflation recycling: warm-start-beats-cold on a repeat fingerprint,
+  cache invalidation on dtype change / escalation / SDC-suspect
+  cohorts / journal recovery (a recovered process REBUILDS the basis),
+  byte-budget eviction, and the poisoned-basis fallback that never
+  returns a wrong answer;
+- the serve layer: ``:blk``/``:defl`` cohort splits, block batch
+  formation requiring one shared operator, basis-holder sticky
+  routing, loud submission validation;
+- the regression sentinel: ``krylov_mode``/``deflation``/
+  ``repeat_fingerprint`` join the cohort key so warm/block runs never
+  judge cold/independent baselines.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.geometry.dsl import Ellipse, Rectangle
+from poisson_tpu.geometry.manufactured import case_by_name, cases
+from poisson_tpu.krylov import (
+    KRYLOV_BLOCK,
+    KRYLOV_INDEPENDENT,
+    KrylovPolicy,
+    resolve_krylov,
+)
+from poisson_tpu.krylov import recycle
+from poisson_tpu.krylov.block import (
+    _solve_block,
+    block_l2_errors,
+    clustered_ellipse_stack,
+)
+from poisson_tpu.obs import metrics
+from poisson_tpu.solvers.batched import reset_bucket_cache, solve_batched
+from poisson_tpu.solvers.pcg import FLAG_CONVERGED, host_setup, pcg_solve
+
+pytestmark = pytest.mark.krylov
+
+DEFL = KrylovPolicy(deflation=True)
+BLK = KrylovPolicy(mode="block")
+
+# Per-family relative-L2 floors for the krylov modes at 100x150 f32,
+# measured with 2x headroom — the same rule (and roughly the same
+# numbers) as the base-path floors in tests/test_geometry_dsl.py: the
+# Krylov programs must land at the family's established floor, not at
+# a new one.
+FAMILY_FLOORS = {
+    "ellipse": 0.038,
+    "ellipse-offset": 0.065,
+    "rectangle": 0.024,
+    "polygon": 0.024,
+    "union": 0.059,
+    "intersection": 0.023,
+    "difference": 0.020,
+    "sdf": 0.071,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    metrics.reset()
+    reset_bucket_cache()
+    recycle.reset_krylov_cache()
+    yield
+    metrics.reset()
+    reset_bucket_cache()
+    recycle.reset_krylov_cache()
+
+
+# -- policy resolution ---------------------------------------------------
+
+def test_resolve_krylov_defaults_and_rejections():
+    assert resolve_krylov(None).mode == KRYLOV_INDEPENDENT
+    assert not resolve_krylov(None).deflation
+    with pytest.raises(ValueError, match="unknown krylov mode"):
+        resolve_krylov(KrylovPolicy(mode="blockish"))
+    with pytest.raises(ValueError, match="does not compose"):
+        resolve_krylov(KrylovPolicy(mode=KRYLOV_BLOCK, deflation=True))
+    with pytest.raises(ValueError, match="harvest"):
+        resolve_krylov(KrylovPolicy(deflation=True, harvest=4, keep=8))
+
+
+# -- default path untouched ----------------------------------------------
+
+def test_mode_independent_is_the_historical_call():
+    p = Problem(M=40, N=40)
+    a = solve_batched(p, rhs_gates=[1.0, 1.3])
+    b = solve_batched(p, rhs_gates=[1.0, 1.3], mode="independent")
+    assert np.array_equal(np.asarray(a.w), np.asarray(b.w))
+    assert np.array_equal(np.asarray(a.iterations),
+                          np.asarray(b.iterations))
+    assert a.deficient is None and b.deficient is None
+
+
+def test_ledger_pins_mode_independent_to_the_historical_program():
+    """The committed ledger carries the mode='independent' lowering
+    with the SAME fingerprint as the pre-krylov bucket executable —
+    the byte-identity half of the acceptance criteria, from the
+    artifact the gate actually checks."""
+    from poisson_tpu.contracts.manifest import LEDGER_PATH
+
+    with open(LEDGER_PATH) as f:
+        entries = json.load(f)["entries"]
+    assert "batched.mode_independent_f64" in entries
+    assert (entries["batched.mode_independent_f64"]["fingerprint"]
+            == entries["batched.mesh_none_f64"]["fingerprint"])
+
+
+# -- block mode ----------------------------------------------------------
+
+def test_block_rank_deficient_batch_degrades_gracefully():
+    """Pure RHS rescalings — an exactly rank-1 block — must converge
+    every member at (about) the single-solve rate with the deficiency
+    DETECTED, not break down: the O'Leary remedy, measured."""
+    p = Problem(M=60, N=60)
+    solo = int(pcg_solve(p, dtype="float32").iterations)
+    r = solve_batched(p, rhs_gates=[1.0, 1.4, 0.7], dtype="float32",
+                      mode="block")
+    assert (np.asarray(r.flag) == FLAG_CONVERGED).all()
+    assert bool(np.asarray(r.deficient))
+    assert int(np.asarray(r.max_iterations)) <= solo + 5
+
+
+def test_block_cuts_total_iterations_on_clustered_batch():
+    """The headline lever at test scale: ≥15%% total-iteration cut on
+    the clustered-RHS batch (the 400x600 bench measures ≥25%% — same
+    construction, BENCH.md)."""
+    p = Problem(M=160, N=240)
+    B = 8
+    fs, us, inside = clustered_ellipse_stack(p, B)
+    ri = solve_batched(p, rhs_stack=fs, dtype="float32")
+    rb = solve_batched(p, rhs_stack=fs, dtype="float32", mode="block")
+    assert (np.asarray(rb.flag) == FLAG_CONVERGED).all()
+    indep_total = int(np.asarray(ri.iterations).sum())
+    block_total = B * int(np.asarray(rb.max_iterations))
+    cut = 1.0 - block_total / indep_total
+    assert cut >= 0.15, (indep_total, block_total)
+    # …at the same L2 floor, each member against its EXACT solution.
+    l2_i = block_l2_errors(p, ri, us, inside)
+    l2_b = block_l2_errors(p, rb, us, inside)
+    assert max(l2_b) <= 1.2 * max(l2_i) + 1e-12
+
+
+@pytest.mark.parametrize("name", sorted(FAMILY_FLOORS))
+def test_block_per_family_l2_floor(name):
+    r = case_by_name(name)
+    out = __import__("poisson_tpu.geometry.manufactured",
+                     fromlist=["manufactured_error"]).manufactured_error(
+        r, 100, 150, dtype="float32", krylov=BLK)
+    assert out["flags"] == [1, 1, 1], out
+    assert out["rel"] <= FAMILY_FLOORS[name], out
+
+
+@pytest.mark.parametrize("name", sorted(FAMILY_FLOORS))
+def test_deflated_per_family_l2_floor_and_warm_win(name):
+    from poisson_tpu.geometry.manufactured import manufactured_error
+
+    out = manufactured_error(case_by_name(name), 100, 150,
+                             dtype="float32", krylov=DEFL)
+    assert out["flag"] == 1, out
+    assert out["rel"] <= FAMILY_FLOORS[name], out
+    assert out["iterations"] < out["cold_iterations"], out
+
+
+def test_block_rejections_are_loud():
+    p = Problem(M=40, N=40)
+    g1 = Ellipse(cx=0.1, cy=0.0, rx=0.5, ry=0.3)
+    g2 = Rectangle(-0.5, -0.3, 0.5, 0.3)
+    with pytest.raises(ValueError, match="unknown mode"):
+        solve_batched(p, rhs_gates=[1.0], mode="blk")
+    with pytest.raises(ValueError, match="sharded"):
+        solve_batched(p, rhs_gates=[1.0, 1.1], mode="block",
+                      mesh=object())
+    with pytest.raises(ValueError, match="integrity probe"):
+        solve_batched(p, rhs_gates=[1.0, 1.1], mode="block",
+                      verify_every=5)
+    with pytest.raises(ValueError, match="jacobi"):
+        solve_batched(p, rhs_gates=[1.0, 1.1], mode="block",
+                      preconditioner="mg")
+    with pytest.raises(ValueError, match="exact-size"):
+        solve_batched(p, rhs_gates=[1.0, 1.1], mode="block", bucket=8)
+    with pytest.raises(ValueError, match="ONE shared operator"):
+        solve_batched(p, rhs_gates=[1.0, 1.1], mode="block",
+                      geometries=[g1, g2])
+
+
+def test_block_shared_geometry_and_bucket_key_family():
+    """A fingerprint-uniform geometry block runs on the shared
+    canvases, and block executables form their own bucket-cache key
+    family (a block dispatch never claims reuse of the independent
+    executable)."""
+    p = Problem(M=40, N=40)
+    g = Ellipse(cx=0.1, cy=0.0, rx=0.5, ry=0.3)
+    solve_batched(p, rhs_gates=[1.0, 1.2], dtype="float32")
+    assert metrics.get("batched.bucket_cache.misses") == 1
+    r = solve_batched(p, rhs_gates=[1.0, 1.2], dtype="float32",
+                      mode="block", geometries=[g, g])
+    assert (np.asarray(r.flag) == FLAG_CONVERGED).all()
+    # block dispatch = a NEW executable family, not a hit on the
+    # independent one
+    assert metrics.get("batched.bucket_cache.misses") == 2
+    assert metrics.get("batched.bucket_cache.hits") == 0
+    assert metrics.get("krylov.block.solves") == 2
+
+
+# -- deflation recycling -------------------------------------------------
+
+def test_recycle_warm_beats_cold_and_counts():
+    p = Problem(M=60, N=60)
+    cold = recycle.solve_recycled(p, dtype="float32", policy=DEFL)
+    warm = recycle.solve_recycled(p, dtype="float32", policy=DEFL,
+                                  rhs_gate=1.5)
+    assert int(cold.flag) == FLAG_CONVERGED
+    assert int(warm.flag) == FLAG_CONVERGED
+    assert int(warm.iterations) < int(cold.iterations)
+    assert metrics.get("krylov.cache.misses") == 1
+    assert metrics.get("krylov.cache.hits") == 1
+    assert metrics.get("krylov.harvests") == 1
+    assert metrics.get("krylov.warm_solves") == 1
+    assert metrics.get("krylov.iterations_saved") >= 1
+
+
+def test_recycle_dtype_change_misses():
+    """Escalation invalidation by construction: the basis key carries
+    the dtype, so an f64 request after an f32 harvest re-harvests."""
+    p = Problem(M=40, N=40)
+    recycle.solve_recycled(p, dtype="float32", policy=DEFL)
+    assert recycle.has_basis(p, dtype="float32", policy=DEFL)
+    assert not recycle.has_basis(p, dtype="float64", policy=DEFL)
+    recycle.solve_recycled(p, dtype="float64", policy=DEFL)
+    assert metrics.get("krylov.cache.misses") == 2
+    assert metrics.get("krylov.cache.hits") == 0
+
+
+def test_recycle_eviction_respects_byte_budget():
+    tiny = KrylovPolicy(deflation=True, harvest=16, keep=4,
+                        budget_bytes=1)
+    p = Problem(M=40, N=40)
+    recycle.solve_recycled(p, dtype="float32", policy=tiny)
+    recycle.solve_recycled(p, dtype="float32", policy=tiny,
+                           geometry=Ellipse(cx=0.1, cy=0.0, rx=0.5,
+                                            ry=0.3))
+    # over-budget: the LRU keeps only the newest entry
+    assert metrics.get("krylov.cache.evictions") >= 1
+    assert recycle.cache_stats()["entries"] == 1
+
+
+def test_recycle_poisoned_basis_falls_back_never_wrong():
+    p = Problem(M=60, N=60)
+    cold = recycle.solve_recycled(p, dtype="float32", policy=DEFL)
+    assert recycle.poison_basis() == 1
+    again = recycle.solve_recycled(p, dtype="float32", policy=DEFL,
+                                   rhs_gate=0.8)
+    assert int(again.flag) == FLAG_CONVERGED
+    assert np.isfinite(np.asarray(again.w)).all()
+    assert metrics.get("krylov.fallbacks") == 1
+    assert metrics.get("krylov.cache.invalidations") == 1
+    # the fallback cold solve re-harvested: the next request is warm
+    warm = recycle.solve_recycled(p, dtype="float32", policy=DEFL,
+                                  rhs_gate=1.2)
+    assert int(warm.iterations) < int(cold.iterations)
+
+
+def test_recycle_invalidate_selectors():
+    p = Problem(M=40, N=40)
+    g = Ellipse(cx=0.1, cy=0.0, rx=0.5, ry=0.3)
+    recycle.solve_recycled(p, dtype="float32", policy=DEFL,
+                           hw=("xla", "cpu", 0))
+    recycle.solve_recycled(p, dtype="float32", policy=DEFL, geometry=g,
+                           hw=("xla", "cpu", 1))
+    assert recycle.cache_stats()["entries"] == 2
+    # hw selector drops only the matching harvest cohort
+    assert recycle.invalidate(hw=("xla", "cpu", 1), reason="test") == 1
+    assert recycle.has_basis(p, dtype="float32", policy=DEFL)
+    assert not recycle.has_basis(p, dtype="float32", policy=DEFL,
+                                 geometry=g)
+    # fingerprint selector
+    assert recycle.invalidate(fingerprint="default", reason="test") == 1
+    assert recycle.cache_stats()["entries"] == 0
+    assert metrics.get("krylov.cache.invalidations") == 2
+
+
+def test_recycle_unconverged_solve_never_caches():
+    p = Problem(M=60, N=60, max_iter=5)     # cap far below convergence
+    r = recycle.solve_recycled(p, dtype="float32", policy=DEFL)
+    assert int(r.flag) != FLAG_CONVERGED
+    assert metrics.get("krylov.harvests") == 0
+    assert recycle.cache_stats()["entries"] == 0
+
+
+def test_recycle_validation_loud():
+    p = Problem(M=40, N=40)
+    with pytest.raises(ValueError, match="deflation-enabled"):
+        recycle.solve_recycled(p, policy=KrylovPolicy())
+    with pytest.raises(ValueError, match="jacobi"):
+        recycle.solve_recycled(p, policy=DEFL, preconditioner="mg")
+    from poisson_tpu.geometry.manufactured import manufactured_error
+    with pytest.raises(ValueError, match="jacobi"):
+        manufactured_error(case_by_name("ellipse"), 40, 60,
+                           krylov=DEFL, preconditioner="mg")
+
+
+# -- serve threading -----------------------------------------------------
+
+def _vc_service(policy=None, **kw):
+    from poisson_tpu.serve import ServicePolicy, SolveService
+    from poisson_tpu.testing.chaos import VirtualClock
+
+    vc = VirtualClock()
+    svc = SolveService(policy or ServicePolicy(capacity=16),
+                       clock=vc, sleep=vc.sleep, seed=0, **kw)
+    return svc, vc
+
+
+def test_serve_cohort_markers():
+    from poisson_tpu.serve import ServicePolicy, SolveRequest
+
+    p = Problem(M=40, N=40)
+    svc, _ = _vc_service()
+    plain = SolveRequest(request_id=0, problem=p)
+    assert svc._cohort(plain) == "40x40:auto:xla"     # historical string
+    assert svc._cohort(SolveRequest(request_id=1, problem=p,
+                                    krylov=BLK)) == "40x40:auto:xla:blk"
+    assert svc._cohort(SolveRequest(request_id=2, problem=p,
+                                    krylov=DEFL)) == "40x40:auto:xla:defl"
+    g = Ellipse(cx=0.1, cy=0.0, rx=0.5, ry=0.3)
+    assert svc._cohort(SolveRequest(
+        request_id=3, problem=p, krylov=DEFL,
+        geometry=g)) == "40x40:auto:xla:defl:geo"
+    # policy-level default applies the marker service-wide
+    svc2, _ = _vc_service(ServicePolicy(capacity=16, krylov=BLK))
+    assert svc2._cohort(plain) == "40x40:auto:xla:blk"
+
+
+def test_serve_block_batches_require_shared_operator():
+    """Two block requests carrying DIFFERENT fingerprints share the
+    :blk cohort but must never share a dispatch — batch formation is
+    fingerprint-uniform for block heads."""
+    from poisson_tpu.serve import ServicePolicy, SolveRequest
+
+    dispatches = []
+
+    def record(requests, attempts):
+        dispatches.append([r.request_id for r in requests])
+
+    svc, _ = _vc_service(
+        ServicePolicy(capacity=16, max_batch=8, krylov=BLK),
+        dispatch_fault=record)
+    p = Problem(M=40, N=40)
+    g1 = Ellipse(cx=0.1, cy=0.0, rx=0.5, ry=0.3)
+    g2 = Rectangle(-0.5, -0.3, 0.5, 0.3)
+    svc.submit(SolveRequest(request_id="a1", problem=p, geometry=g1))
+    svc.submit(SolveRequest(request_id="a2", problem=p, geometry=g1,
+                            rhs_gate=1.2))
+    svc.submit(SolveRequest(request_id="b1", problem=p, geometry=g2))
+    outs = svc.drain()
+    assert all(o.converged for o in outs)
+    comps = [set(d) for d in dispatches]
+    assert {"a1", "a2"} in comps        # same fingerprint co-batched
+    assert {"b1"} in comps              # different operator solo
+    assert metrics.get("krylov.block.solves") == 3
+
+
+def test_serve_deflation_warm_solves_and_sticky_routing():
+    from poisson_tpu.serve import ServicePolicy, SolveRequest
+
+    p = Problem(M=40, N=40)
+    svc, _ = _vc_service(ServicePolicy(capacity=16, krylov=DEFL))
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=i, problem=p,
+                                rhs_gate=1.0 + i / 10))
+    outs = {o.request_id: o for o in svc.drain()}
+    assert all(o.converged for o in outs.values())
+    assert outs[1].iterations < outs[0].iterations
+    assert outs[2].iterations < outs[0].iterations
+    assert metrics.get("krylov.warm_solves") == 2
+    assert metrics.get("serve.krylov.sticky_hits") == 2
+
+
+def test_serve_validation_loud():
+    from poisson_tpu.serve import SolveRequest
+
+    p = Problem(M=40, N=40)
+    svc, _ = _vc_service()
+    with pytest.raises(ValueError, match="unknown krylov mode"):
+        svc.submit(SolveRequest(request_id="x", problem=p,
+                                krylov=KrylovPolicy(mode="nope")))
+    with pytest.raises(ValueError, match="does not compose"):
+        svc.submit(SolveRequest(
+            request_id="y", problem=p,
+            krylov=KrylovPolicy(mode="block", deflation=True)))
+    with pytest.raises(ValueError, match="chunked"):
+        svc.submit(SolveRequest(request_id="z", problem=p, krylov=DEFL,
+                                deadline_seconds=10.0))
+    with pytest.raises(ValueError, match="jacobi"):
+        svc.submit(SolveRequest(request_id="w", problem=p, krylov=DEFL,
+                                preconditioner="mg"))
+    assert svc.stats()["admitted"] == 0     # nothing entered the ledger
+
+
+def test_journal_recovery_rebuilds_the_basis(tmp_path):
+    """A recovered process REBUILDS the basis rather than trusting
+    unreplayed device state: recovery invalidates the cache audibly,
+    and the next request against the same fingerprint re-harvests."""
+    from poisson_tpu.serve import (
+        ServicePolicy,
+        SolveJournal,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.chaos import VirtualClock
+
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "serve.journal")
+    vc = VirtualClock()
+    policy = ServicePolicy(capacity=16, krylov=DEFL)
+    j1 = SolveJournal(path, clock=vc)
+    svc = SolveService(policy, clock=vc, sleep=vc.sleep, seed=0,
+                       journal=j1)
+    svc.submit(SolveRequest(request_id="r0", problem=p))
+    assert svc.drain()[0].converged
+    assert recycle.has_basis(p, policy=DEFL)
+    j1.close()                              # the process "dies"
+
+    j2 = SolveJournal(path, clock=vc)
+    svc2 = SolveService.recover(j2, policy, clock=vc, sleep=vc.sleep,
+                                seed=0)
+    assert not recycle.has_basis(p, policy=DEFL)
+    assert metrics.get("krylov.cache.invalidations") >= 1
+    misses_before = metrics.get("krylov.cache.misses")
+    svc2.submit(SolveRequest(request_id="r1", problem=p, rhs_gate=1.3))
+    assert svc2.drain()[0].converged
+    assert metrics.get("krylov.cache.misses") == misses_before + 1
+    assert metrics.get("krylov.harvests") >= 2
+    j2.close()
+
+
+def test_verify_demand_suspends_krylov_audibly():
+    """The SDC defense wins over Krylov acceleration: with an always-on
+    integrity stride, block batches dispatch through the VERIFIED
+    independent program and deflation requests through the verified
+    chunked path — converged typed results, zero internal errors, the
+    suspension counted (serve.krylov.verify_suspensions) — instead of
+    either crashing (block + verify_every used to ValueError into
+    non-retried internal errors) or silently running unverified on
+    flip-suspect silicon."""
+    from poisson_tpu.integrity.probe import IntegrityPolicy
+    from poisson_tpu.serve import ServicePolicy, SolveRequest
+
+    p = Problem(M=40, N=40)
+    svc, _ = _vc_service(ServicePolicy(
+        capacity=16, max_batch=4,
+        integrity=IntegrityPolicy(verify_every=10)))
+    svc.submit(SolveRequest(request_id="b0", problem=p, krylov=BLK))
+    svc.submit(SolveRequest(request_id="b1", problem=p, krylov=BLK,
+                            rhs_gate=1.2))
+    svc.submit(SolveRequest(request_id="d0", problem=p, krylov=DEFL))
+    outs = {o.request_id: o for o in svc.drain()}
+    assert all(o.kind == "result" and o.converged
+               for o in outs.values()), outs
+    assert metrics.get("serve.errors") == 0
+    assert metrics.get("serve.krylov.verify_suspensions") >= 2
+    # nothing ran the unverified krylov programs
+    assert metrics.get("krylov.block.solves") == 0
+    assert metrics.get("krylov.cache.misses") == 0
+
+
+def test_journal_replays_request_level_krylov(tmp_path):
+    """A crashed request-level block/deflation knob re-dispatches
+    through the SAME cohort after replay — the policy rides the
+    journal (the basis never does)."""
+    from poisson_tpu.serve import (
+        ServicePolicy,
+        SolveJournal,
+        SolveRequest,
+        SolveService,
+        replay_journal,
+    )
+    from poisson_tpu.testing.chaos import VirtualClock
+
+    p = Problem(M=40, N=40)
+    path = str(tmp_path / "j")
+    vc = VirtualClock()
+    j = SolveJournal(path, clock=vc)
+    svc = SolveService(ServicePolicy(capacity=8), clock=vc,
+                       sleep=vc.sleep, journal=j)
+    svc.submit(SolveRequest(request_id="k0", problem=p, krylov=DEFL))
+    svc.submit(SolveRequest(request_id="k1", problem=p, krylov=BLK))
+    j.close()                               # crash before dispatch
+    rep = replay_journal(path)
+    assert rep.torn_records == 0
+    by_id = {pend.request.request_id: pend.request
+             for pend in rep.pending}
+    assert by_id["k0"].krylov == DEFL
+    assert by_id["k1"].krylov == BLK
+
+
+def test_chaos_deflation_stale_basis_green():
+    from poisson_tpu.testing.chaos import run_scenario
+
+    report = run_scenario("deflation-stale-basis", seed=0)
+    assert report["ok"], report["checks"]
+    assert report["invariant"]["lost"] == 0
+
+
+# -- cost models & sentinel pins -----------------------------------------
+
+def test_krylov_cost_models():
+    from poisson_tpu.obs.costs import (
+        analytic_iteration_cost,
+        krylov_block_cost,
+        krylov_deflated_cost,
+    )
+
+    base = analytic_iteration_cost(400, 600)
+    blk = krylov_block_cost(400, 600, 8)
+    assert blk["bytes"] > 8 * base["bytes"]          # coupling surcharge
+    assert blk["bytes_per_member_iteration"] > base["bytes"]
+    defl = krylov_deflated_cost(400, 600, 9)
+    assert defl["bytes"] == pytest.approx(
+        base["bytes"] + 18 * 401 * 601 * 4)
+    assert metrics.snapshot()["gauges"][
+        "cost.krylov.block_bytes_per_iter"] == blk["bytes"]
+    assert metrics.snapshot()["gauges"][
+        "cost.krylov.deflated_passes"] == defl["passes"]
+
+
+def test_sentinel_lifts_krylov_detail_into_cohort():
+    import benchmarks.regress as regress
+
+    warm = {"metric": "serve.sustained_solves_per_sec", "value": 30.0,
+            "detail": {"grid": [96, 144], "dtype": "float32",
+                       "platform": "cpu", "backend": "xla_serve",
+                       "devices": 1, "arrival_rate": 40.0,
+                       "deflation": True, "repeat_fingerprint": 3,
+                       "krylov_mode": "independent",
+                       "fault_load": "clean"}}
+    cold = {"metric": "serve.sustained_solves_per_sec", "value": 8.0,
+            "detail": {"grid": [96, 144], "dtype": "float32",
+                       "platform": "cpu", "backend": "xla_serve",
+                       "devices": 1, "arrival_rate": 40.0,
+                       "fault_load": "clean"}}
+    rw = regress.record_from_result(warm, "warm")
+    rc = regress.record_from_result(cold, "cold")
+    assert rw["deflation"] is True and rw["repeat_fingerprint"] == 3
+    assert regress.cohort_key(rw) != regress.cohort_key(rc)
+    # a warm-dominated run never judges the cold baseline: evaluating
+    # both together raises no alarm despite the 4x value gap
+    verdict = regress.evaluate([rc, rc, rc, rw])
+    assert not verdict["regressions"]
+    # block A/B records split from the plain batched cohort the same way
+    blk = regress.record_from_result(
+        {"metric": "batched_solves_per_sec", "value": 1.0,
+         "detail": {"grid": [400, 600], "dtype": "float32",
+                    "platform": "cpu", "backend": "xla_batched",
+                    "devices": 1, "krylov_mode": "block"}}, "blk")
+    ind = regress.record_from_result(
+        {"metric": "batched_solves_per_sec", "value": 5.0,
+         "detail": {"grid": [400, 600], "dtype": "float32",
+                    "platform": "cpu", "backend": "xla_batched",
+                    "devices": 1}}, "ind")
+    assert regress.cohort_key(blk) != regress.cohort_key(ind)
+
+
+def test_manufactured_block_gate_shape():
+    out = __import__("poisson_tpu.geometry.manufactured",
+                     fromlist=["manufactured_error"]).manufactured_error(
+        case_by_name("ellipse"), 60, 90, dtype="float32", krylov=BLK)
+    assert set(out) >= {"case", "l2", "rel", "iterations", "flags",
+                        "deficient"}
+    assert len(cases()) == 8        # the floor table covers every family
+    assert set(FAMILY_FLOORS) == {c.name for c in cases()}
