@@ -734,6 +734,200 @@ def _krylov_block_bench(problem, block_b: int, devices, platform: str,
     return 0 if converged else 1
 
 
+def _session_bench(problem, steps: int, devices, platform: str,
+                   downgraded: bool = False) -> int:
+    """Durable-session open-loop mode (``--session STEPS [M N]``): ONE
+    moving-ellipse session (cx drifts 1e-4/step — a boundary-resolving
+    schedule: ~1.5 grid cells of total motion over a 100-step stream
+    at the default 300×450 grid) admitted through
+    :class:`poisson_tpu.serve.SessionHost` vs the SAME schedule run as
+    independent cold ``pcg_solve`` calls — the dependent-stream
+    experiment the session subsystem exists for. The canvas cache is
+    reset before EACH arm so both pay the per-step geometry build a
+    moving domain actually costs (the arms must differ in solver work
+    only), and the warm/gate programs are compiled outside the timers
+    like the cold program is.
+
+    The headline is **steps/sec** (``session.steps_per_sec`` — its own
+    sentinel cohort via ``detail.session``/``detail.warm_start``:
+    a warm-started stream never judges cold solves, or vice versa).
+    Both arms are gated at the SAME manufactured-solution floor every
+    step (the quadratic ellipse oracle, BENCH.md rule): a warm start
+    that drifted off the exact solution would fail the gate, so the
+    speedup can never hide a wrong answer. Warm hit rate, audible
+    fallbacks, and net iterations saved ride in ``detail.session_ab``.
+    """
+    import numpy as np
+
+    from poisson_tpu import obs
+    from poisson_tpu.obs import metrics as obs_metrics
+    from poisson_tpu.geometry import Ellipse
+    from poisson_tpu.serve import ServicePolicy, SessionHost, SolveService
+    from poisson_tpu.solvers.pcg import pcg_solve, resolve_dtype
+    from poisson_tpu.solvers.session import reset_session_cache
+    from poisson_tpu.utils.timing import fence
+
+    drift = 1e-4
+
+    def spec(k):
+        return Ellipse(cx=drift * k)
+
+    def rel_l2(e, w):
+        # Weighted L2 of (w − u_exact) over nodes strictly inside the
+        # ellipse, relative to ‖u_exact‖ — the BENCH.md oracle rule
+        # (geometry.manufactured applies the same to every family).
+        x = (problem.x_min + np.arange(problem.M + 1, dtype=np.float64)
+             * problem.h1)[:, None]
+        y = (problem.y_min + np.arange(problem.N + 1, dtype=np.float64)
+             * problem.h2)[None, :]
+        mask = e.contains(x, y, np)
+        c = problem.f_val / (2.0 * (1.0 / e.rx ** 2 + 1.0 / e.ry ** 2))
+        tx = (x - e.cx) / e.rx
+        ty = (y - e.cy) / e.ry
+        u = np.where(mask, c * (1.0 - tx * tx - ty * ty), 0.0)
+        w64 = np.asarray(w, np.float64)
+        scale = problem.h1 * problem.h2
+        l2 = float(np.sqrt(np.where(mask, (w64 - u) ** 2, 0.0).sum()
+                           * scale))
+        norm = float(np.sqrt(np.where(mask, u ** 2, 0.0).sum() * scale))
+        return l2 / norm if norm > 0 else float("inf")
+
+    dtype_name = resolve_dtype(None)
+
+    from poisson_tpu.geometry.canvas import reset_geometry_cache
+    from poisson_tpu.solvers.session import session_step_solve
+
+    # Warm-up: compile BOTH arms' programs outside the timers — the
+    # cold program, and the warm-start + gate programs via a throwaway
+    # warm step at a spec far off the measured schedule (the moving
+    # ellipse changes canvases, never shapes, so one compile serves
+    # every step).
+    with obs.span("bench.session_warmup", fence=False, steps=steps):
+        t0 = time.perf_counter()
+        r0 = pcg_solve(problem, geometry=Ellipse(cx=-0.3))
+        fence(r0.iterations)
+        rw, _ = session_step_solve(
+            problem, geometry=Ellipse(cx=-0.3 + drift),
+            warm=np.asarray(r0.w), warm_geometry=Ellipse(cx=-0.3))
+        fence(rw.iterations)
+        compile_secs = time.perf_counter() - t0
+    obs.inc("time.compile_seconds", compile_secs)
+
+    # Cold arm: the schedule as independent solves (zero init each
+    # step). The canvas cache is reset first so this arm pays the same
+    # per-step geometry build the session arm will. Solutions are kept
+    # as device arrays and scored after the timer — the oracle is a
+    # gate, not part of the measured work.
+    reset_geometry_cache()
+    cold_results = []
+    t0 = time.perf_counter()
+    for k in range(steps):
+        r = pcg_solve(problem, geometry=spec(k))
+        fence(r.iterations)
+        cold_results.append(r)
+    cold_secs = time.perf_counter() - t0
+
+    # Session arm: the same schedule as ONE dependent stream through
+    # the service (sess.warm — the host-side iterate the on_solution
+    # hook delivered — is scored after the timer, like the cold arm).
+    reset_session_cache()
+    reset_geometry_cache()
+    hits0 = obs_metrics.get("session.warm.hits")
+    falls0 = obs_metrics.get("session.warm.fallbacks")
+    svc = SolveService(ServicePolicy(capacity=max(16, steps + 2)))
+    host = SessionHost(svc)
+    sess = host.open("bench-session", problem, geometry=spec(0))
+    if sess is None:
+        print("bench: session open was shed on an idle service",
+              file=sys.stderr)
+        return 1
+    sess_outs = []
+    sess_sols = []
+    t0 = time.perf_counter()
+    for k in range(steps):
+        out = host.step(sess, geometry=spec(k))
+        sess_outs.append(out)
+        sess_sols.append(sess.warm)
+    sess_secs = time.perf_counter() - t0
+    summary = host.close(sess)
+    warm_hits = int(obs_metrics.get("session.warm.hits") - hits0)
+    fallbacks = int(obs_metrics.get("session.warm.fallbacks") - falls0)
+
+    cold_iters = [int(r.iterations) for r in cold_results]
+    sess_iters = [int(o.iterations) for o in sess_outs]
+    cold_rels = [rel_l2(spec(k), cold_results[k].w)
+                 for k in range(steps)]
+    sess_rels = [rel_l2(spec(k), sess_sols[k]) for k in range(steps)
+                 if sess_sols[k] is not None]
+    # The floor is the cold arm's own worst step (+20% headroom for
+    # iteration-count wobble between inits): every session step must
+    # land at the same manufactured-solution accuracy.
+    floor = 1.2 * max(cold_rels) + 1e-12
+    l2_ok = (len(sess_rels) == steps
+             and all(r <= floor for r in sess_rels))
+    converged = (all(int(r.flag) == 1 for r in cold_results)
+                 and all(o.converged for o in sess_outs))
+    lost = svc.stats()["lost"]
+    steps_per_sec = steps / sess_secs if sess_secs > 0 else None
+    cold_sps = steps / cold_secs if cold_secs > 0 else None
+    speedup = (cold_secs / sess_secs if sess_secs > 0 else None)
+    record = {
+        "metric": "session.steps_per_sec",
+        "value": round(steps_per_sec, 3) if steps_per_sec else None,
+        "unit": "steps/sec",
+        "detail": {
+            "grid": [problem.M, problem.N],
+            "dtype": dtype_name,
+            "backend": "xla_session",
+            "devices": len(devices),
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "platform_fallback": downgraded,
+            "first_run_seconds": round(compile_secs, 2),
+            # Experiment identity for the sentinel (regress.cohort_key
+            # via detail.session/detail.warm_start): a warm-started
+            # dependent stream is its own cohort.
+            "session": True,
+            "warm_start": True,
+            "steps": steps,
+            "session_ab": {
+                "session_seconds": round(sess_secs, 4),
+                "cold_seconds": round(cold_secs, 4),
+                "cold_solves_per_sec": (round(cold_sps, 3)
+                                        if cold_sps else None),
+                "speedup": round(speedup, 2) if speedup else None,
+                "warm_hit_rate": round(warm_hits / steps, 4),
+                "warm_fallbacks": fallbacks,
+                "iterations_total": sum(sess_iters),
+                "iterations_total_cold": sum(cold_iters),
+                "iterations_saved": sum(cold_iters) - sum(sess_iters),
+                "l2_rel_max_cold": round(max(cold_rels), 6),
+                "l2_rel_max_session": (round(max(sess_rels), 6)
+                                       if sess_rels else None),
+                "l2_at_floor": l2_ok,
+                "slo_good": bool(summary["slo_good"]),
+                "lost": lost,
+            },
+        },
+    }
+    obs.event("bench.session", grid=[problem.M, problem.N], steps=steps,
+              steps_per_sec=(round(steps_per_sec, 3)
+                             if steps_per_sec else None),
+              cold_solves_per_sec=(round(cold_sps, 3)
+                                   if cold_sps else None),
+              speedup=round(speedup, 2) if speedup else None,
+              warm_hit_rate=round(warm_hits / steps, 4),
+              iterations_saved=sum(cold_iters) - sum(sess_iters),
+              session_beats_cold=bool(speedup and speedup > 1.0))
+    obs.gauge("bench.session_steps_per_sec",
+              round(steps_per_sec, 3) if steps_per_sec else 0.0)
+    obs.gauge("bench.session_speedup",
+              round(speedup, 2) if speedup else 0.0)
+    obs.finalize()
+    print(json.dumps(record))
+    return 0 if (converged and l2_ok and lost == 0) else 1
+
+
 def _zipf_families(requests: int, k: int, seed: int = 0) -> list:
     """A Zipf-ish family index per request: rank r drawn with weight
     1/(r+1) over K families, seeded — the repeat-fingerprint traffic
@@ -1948,6 +2142,29 @@ def main() -> int:
                   "--batch/--serve/--verify-every/--preconditioner",
                   file=sys.stderr)
             return 2
+    session_steps = None
+    if "--session" in argv:
+        i = argv.index("--session")
+        try:
+            session_steps = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("usage: python bench.py --session STEPS [M N]",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if session_steps < 2:
+            print(f"--session must be >= 2, got {session_steps} "
+                  "(one step has no warm start to measure)",
+                  file=sys.stderr)
+            return 2
+        if (batch is not None or serve_requests is not None
+                or verify_every_arg is not None
+                or preconditioner_arg is not None
+                or krylov_block is not None):
+            print("--session is its own A/B bench mode; drop --batch/"
+                  "--serve/--verify-every/--preconditioner/"
+                  "--krylov-block", file=sys.stderr)
+            return 2
     repeat_fingerprint = None
     if "--repeat-fingerprint" in argv:
         i = argv.index("--repeat-fingerprint")
@@ -1989,12 +2206,20 @@ def main() -> int:
     if len(argv) == 2:
         problem = Problem(M=int(argv[0]), N=int(argv[1]))
     elif len(argv) == 0:
-        problem = (Problem(M=400, N=600)
-                   if batch is not None or serve_requests is not None
-                   or verify_every_arg is not None
-                   or preconditioner_arg is not None
-                   or krylov_block is not None
-                   else Problem(M=800, N=1200))
+        if session_steps is not None:
+            # Session mode default: small enough that 2×STEPS solves
+            # (both arms) stay CPU-friendly (~30 s for 100 steps), big
+            # enough that the warm start's iteration cut dominates the
+            # fixed per-step cost both arms share (canvas build,
+            # admission, transfers) instead of drowning in it.
+            problem = Problem(M=300, N=450)
+        else:
+            problem = (Problem(M=400, N=600)
+                       if batch is not None or serve_requests is not None
+                       or verify_every_arg is not None
+                       or preconditioner_arg is not None
+                       or krylov_block is not None
+                       else Problem(M=800, N=1200))
     else:
         print("usage: python bench.py [--batch B | --serve R] [M N]",
               file=sys.stderr)
@@ -2039,6 +2264,9 @@ def main() -> int:
     if krylov_block is not None:
         return _krylov_block_bench(problem, krylov_block, devices,
                                    platform, downgraded=downgraded)
+    if session_steps is not None:
+        return _session_bench(problem, session_steps, devices, platform,
+                              downgraded=downgraded)
     if batch is not None:
         return _batched_bench(problem, batch, devices, platform,
                               downgraded=downgraded)

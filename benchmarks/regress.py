@@ -91,7 +91,8 @@ _FALLBACK_TAIL_MARKS = (
 
 _METRICS = ("mlups", "batched_solves_per_sec",
             "serve.p99_latency", "serve.shed_rate",
-            "serve.sustained_solves_per_sec")
+            "serve.sustained_solves_per_sec",
+            "session.steps_per_sec")
 
 # Service metrics regress UPWARD: a p99 latency or a shed rate that grew
 # is the slowdown, where MLUPS/solves-per-sec regress downward. The
@@ -114,6 +115,8 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                krylov_mode: Optional[str] = None,
                deflation: Optional[bool] = None,
                repeat_fingerprint: Optional[int] = None,
+               session: Optional[bool] = None,
+               warm_start: Optional[bool] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -172,6 +175,14 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         "krylov_mode": krylov_mode,
         "deflation": deflation,
         "repeat_fingerprint": repeat_fingerprint,
+        # Durable-session records (bench.py --session STEPS): a
+        # warm-started dependent stream answers most steps from the
+        # previous iterate, so its steps/sec is a different experiment
+        # from independent cold solves — neither may judge (or hide
+        # behind) the other. Cohort key; the direction pin stays the
+        # metric's own (steps/sec alarms on a DROP, like MLUPS).
+        "session": session,
+        "warm_start": warm_start,
         "failed": bool(failed),
         "note": note,
     }
@@ -213,6 +224,8 @@ def record_from_result(result: dict, source: str,
         krylov_mode=det.get("krylov_mode"),
         deflation=det.get("deflation"),
         repeat_fingerprint=det.get("repeat_fingerprint"),
+        session=det.get("session"),
+        warm_start=det.get("warm_start"),
     )
 
 
@@ -313,7 +326,8 @@ def cohort_key(rec: dict):
     verified solve never indicts an unverified baseline; an MG run
     never judges a Jacobi one; a block batch never judges the
     independent family; a warm repeat-fingerprint run never judges a
-    cold baseline — or vice versa, all of them)."""
+    cold baseline; a warm-started session stream never judges
+    independent cold solves — or vice versa, all of them)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
             rec.get("devices"), rec.get("fault_load"),
@@ -321,7 +335,8 @@ def cohort_key(rec: dict):
             rec.get("geometry_mix"), rec.get("verify_every"),
             rec.get("preconditioner"), rec.get("device_topology"),
             rec.get("krylov_mode"), rec.get("deflation"),
-            rec.get("repeat_fingerprint"))
+            rec.get("repeat_fingerprint"),
+            rec.get("session"), rec.get("warm_start"))
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

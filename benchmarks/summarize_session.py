@@ -22,6 +22,8 @@ durations, restarts/escalations, checkpoint activity, watchdog
 beats/stalls, stop verdicts, MLUPS, the streamed convergence curve
 summary, the continuous-batching refill counters (``serve.refill.*``
 plus any open-loop batch-drain-vs-continuous A/B records), the
+solver-session counters (``session.*`` / ``serve.session.*`` plus any
+``bench.py --session`` warm-vs-cold A/B records), the
 performance-attribution gauges (compiled-program cost vs
 the analytic stencil model, achieved-vs-roofline fraction —
 ``poisson_tpu.obs.costs``), and the regression sentinel's verdict over
@@ -408,6 +410,53 @@ def telemetry_report(tdir: pathlib.Path) -> int:
                   f"({e.get('warm_requests')} request(s)), hit rate "
                   f"{e.get('krylov_hit_rate')} — the repeat-operator "
                   f"warm-start win, measured.")
+
+    # Solver sessions (serve.session): durable stream lifecycles, the
+    # warm-start hit/fallback arithmetic, recovery activity, and the
+    # open-loop session bench's warm-vs-cold verdict (bench.py
+    # --session).
+    session_counters = {name: val for name, val in counters.items()
+                        if name.startswith(("session.",
+                                            "serve.session."))}
+    session_bench = [e for e in events if e.get("kind") == "event"
+                     and e.get("name") == "bench.session"]
+    if session_counters or session_bench:
+        print("\n## Solver sessions\n")
+        if session_counters:
+            print("| session counter | value |")
+            print("|---|---|")
+            for name in sorted(session_counters):
+                val = session_counters[name]
+                shown = (f"{val:.4f}" if isinstance(val, float)
+                         and val != int(val) else str(int(val)))
+                print(f"| {name} | {shown} |")
+            steps = session_counters.get("session.steps", 0)
+            hits = session_counters.get("session.warm.hits", 0)
+            falls = session_counters.get("session.warm.fallbacks", 0)
+            rate = (hits / steps) if steps else 0.0
+            print(f"\nwarm hit rate {rate:.0%} ({int(hits)} warm of "
+                  f"{int(steps)} step(s)); {int(falls)} stale-warm "
+                  f"fallback(s) (each an audible "
+                  f"``session.warm.fallback`` event, never a silent "
+                  f"wrong start); "
+                  f"{int(session_counters.get('session.recovered', 0))} "
+                  f"session(s) recovered from the journal at the "
+                  f"committed step boundary; "
+                  f"{int(session_counters.get('session.step.deadline_misses', 0))} "
+                  f"step deadline miss(es).")
+        for e in session_bench:
+            grid = e.get("grid") or ["?", "?"]
+            verdict = ("warm stream beat cold solves"
+                       if e.get("session_beats_cold")
+                       else "cold solves held their own (warm starts "
+                            "not paying on this schedule)")
+            print(f"- {grid[0]}x{grid[1]} x {e.get('steps')} steps: "
+                  f"session {e.get('steps_per_sec')} steps/s vs cold "
+                  f"{e.get('cold_solves_per_sec')} sv/s "
+                  f"(speedup {e.get('speedup')}x, warm hit rate "
+                  f"{e.get('warm_hit_rate')}, "
+                  f"{e.get('iterations_saved')} iteration(s) saved) — "
+                  f"{verdict}")
 
     # Flight recorder (obs.flight): per-request causal traces and their
     # latency decompositions — render the aggregate view plus ONE

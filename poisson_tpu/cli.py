@@ -28,6 +28,15 @@ chaos testing"):
                               [--trace-dir DIR] [--json]
     python -m poisson_tpu chaos --all --seed 0 [--out-dir DIR] [--json]
 
+plus durable solver sessions (``serve.session`` — README "Solver
+sessions"): a crash-safe ordered stream of dependent solves (moving
+ellipse, or implicit-Euler heat with ``--heat``) warm-started step to
+step, journaled, and replayable to the exact step boundary:
+
+    python -m poisson_tpu session M N --steps K [--heat --dt S]
+                              [--journal PATH] [--recover]
+                              [--kill-after K] [--json]
+
 plus the flight-recorder viewer (``obs.flight`` — one request's causal
 timeline and latency decomposition, read from the JSONL event log):
 
@@ -1576,6 +1585,182 @@ def _main_chaos(argv) -> int:
     return 0 if campaign["ok"] else 1
 
 
+def build_session_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="poisson_tpu session",
+        description="Durable solver session (serve.session): an ordered "
+                    "stream of dependent solves — a moving-ellipse "
+                    "Poisson schedule, or implicit-Euler heat stepping "
+                    "with --heat — admitted through the service with "
+                    "warm starts, full journaling, and --recover replay "
+                    "to the exact step boundary.")
+    p.add_argument("M", type=int, help="grid height")
+    p.add_argument("N", type=int, help="grid width")
+    p.add_argument("--steps", type=int, default=10, metavar="K",
+                   help="total steps in the stream (default 10); with "
+                        "--recover, the schedule resumes at the "
+                        "journal's committed boundary and runs to the "
+                        "SAME total")
+    p.add_argument("--heat", action="store_true",
+                   help="implicit-Euler heat stepping (A + I/dt) "
+                        "instead of the moving-domain Poisson schedule")
+    p.add_argument("--dt", type=float, default=0.01,
+                   help="implicit-Euler time step for --heat "
+                        "(mass shift m = 1/dt; default 0.01)")
+    p.add_argument("--drift", type=float, default=5e-4, metavar="D",
+                   help="per-step ellipse center drift of the moving-"
+                        "domain schedule (default 5e-4 — inside the "
+                        "warm validity bound, so warm starts hold)")
+    p.add_argument("--session-id", default="cli", metavar="SID",
+                   help="stream identity (default 'cli') — what the "
+                        "journal and the recovery key on")
+    p.add_argument("--journal", metavar="PATH", default=None,
+                   help="write-ahead journal for the stream AND its "
+                        "steps (serve.journal)")
+    p.add_argument("--recover", action="store_true",
+                   help="replay --journal first: re-open the stream at "
+                        "its committed step boundary (mid-step work "
+                        "re-enqueued COLD by the service's recovery) "
+                        "and finish the schedule")
+    p.add_argument("--kill-after", type=int, default=None, metavar="K",
+                   help="fault injection: die with exit 75 (no cleanup) "
+                        "mid-dispatch of step K — after its submit hit "
+                        "the journal, before its outcome; restart with "
+                        "--recover against the same --journal")
+    p.add_argument("--seed", type=int, default=0,
+                   help="service RNG seed (default 0)")
+    p.add_argument("--metrics-out", metavar="PATH", default=None,
+                   help="write the counters/gauges snapshot here at "
+                        "exit (the merged-ledger evidence of the "
+                        "kill/recover drill)")
+    p.add_argument("--trace-dir", metavar="DIR", default=None,
+                   help="unified telemetry incl. the session's flight "
+                        "trace (one causal tree spanning the stream)")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON line instead of a table")
+    return p
+
+
+def _main_session(argv) -> int:
+    args = build_session_parser().parse_args(argv)
+    if args.steps < 1:
+        raise SystemExit(f"--steps must be >= 1, got {args.steps}")
+    if args.recover and not args.journal:
+        raise SystemExit("--recover needs --journal PATH to replay")
+    if args.kill_after is not None and not args.journal:
+        raise SystemExit("--kill-after without --journal would lose the "
+                         "stream — the drill needs the journal")
+    honor_jax_platforms_env()
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from poisson_tpu import obs
+    from poisson_tpu.utils.compile_cache import enable_from_env
+
+    enable_from_env()
+    if args.metrics_out or args.trace_dir:
+        obs.configure(metrics_path=args.metrics_out,
+                      trace_dir=args.trace_dir)
+    from poisson_tpu.geometry.dsl import Ellipse
+    from poisson_tpu.serve import (
+        OUTCOME_RESULT,
+        SessionHost,
+        SolveJournal,
+        SolveService,
+    )
+
+    problem = Problem(M=args.M, N=args.N)
+    m = (1.0 / args.dt) if args.heat else 0.0
+    kind = "heat" if args.heat else "poisson"
+
+    def schedule(k: int):
+        """Step k's geometry — pure in the step index, so a recovery
+        recomputes the schedule from the committed boundary alone."""
+        if args.heat:
+            return Ellipse()
+        return Ellipse(cx=args.drift * k, cy=0.0, rx=1.0, ry=1.0)
+
+    fault = None
+    if args.kill_after is not None:
+        import os as _os
+
+        kill_at = args.kill_after
+
+        def fault(requests, attempts):
+            # Die mid-dispatch of step K: its session_step + submit
+            # records are journaled, its outcome is not — the genuine
+            # mid-step crash the recovery contract covers.
+            for r in requests:
+                if (r.session_step is not None
+                        and r.session_step >= kill_at):
+                    obs.finalize()
+                    _os._exit(75)
+
+    journal = SolveJournal(args.journal) if args.journal else None
+    t0 = time.perf_counter()
+    if args.recover:
+        svc = SolveService.recover(journal, seed=args.seed,
+                                   dispatch_fault=fault)
+        host = SessionHost(svc)
+        recovered = host.recover()
+        sess = next((s for s in recovered
+                     if s.session_id == args.session_id), None)
+        if sess is None:
+            print(f"session: no open stream {args.session_id!r} in "
+                  f"{args.journal} — nothing to recover",
+                  file=sys.stderr)
+            return 1
+        print(f"session: recovered {sess.session_id!r} at step "
+              f"boundary {sess.advanced} (generation "
+              f"{sess.generation}); continuing cold", file=sys.stderr)
+    else:
+        svc = SolveService(seed=args.seed, journal=journal,
+                           dispatch_fault=fault)
+        host = SessionHost(svc)
+        sess = host.open(args.session_id, problem, kind=kind,
+                         geometry=schedule(0), mass_shift=m,
+                         params={"steps": args.steps,
+                                 "drift": args.drift})
+        if sess is None:
+            print("session: open was shed", file=sys.stderr)
+            return 1
+    outs = []
+    while sess.next_step < args.steps:
+        outs.append(host.step(sess, geometry=schedule(sess.next_step)))
+    summary = host.close(sess)
+    obs.finalize()
+    wall = time.perf_counter() - t0
+    from poisson_tpu.obs import metrics as _metrics
+
+    stats = svc.stats()
+    results = sum(1 for o in outs if o.kind == OUTCOME_RESULT)
+    record = {
+        "M": problem.M, "N": problem.N, "kind": kind,
+        "session_id": sess.session_id,
+        "steps": summary["steps"], "errors": summary["errors"],
+        "steps_run": len(outs), "results": results,
+        "slo_good": summary["slo_good"],
+        "generation": sess.generation,
+        "warm_hits": _metrics.get("session.warm.hits"),
+        "warm_fallbacks": _metrics.get("session.warm.fallbacks"),
+        "recovered_requests": stats["recovered"],
+        "lost": stats["lost"],
+        "wall_seconds": round(wall, 4),
+        "trace_id": summary["trace_id"],
+    }
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print(f"session: {kind} stream {sess.session_id!r} | "
+              f"{record['steps_run']} step(s) run to "
+              f"{summary['steps']} total in {wall:.2f} s")
+        print(f"  warm: {record['warm_hits']} hit(s), "
+              f"{record['warm_fallbacks']} fallback(s) | errors "
+              f"{summary['errors']} | lost {stats['lost']} | "
+              f"SLO {'good' if summary['slo_good'] else 'bad'}")
+    return 0 if (stats["lost"] == 0 and summary["errors"] == 0) else 1
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -1583,6 +1768,8 @@ def main(argv=None) -> int:
         return _main_solve_batched(argv[1:])
     if argv and argv[0] == "serve":
         return _main_serve(argv[1:])
+    if argv and argv[0] == "session":
+        return _main_session(argv[1:])
     if argv and argv[0] == "chaos":
         return _main_chaos(argv[1:])
     if argv and argv[0] == "trace":

@@ -188,6 +188,58 @@ def _build_stencil_apply_A() -> str:
     ).lower(w, np.asarray(a), np.asarray(b)).as_text()
 
 
+def _build_session_step_cold() -> str:
+    """A durable session's cold Poisson step resolved through the REAL
+    entry point: ``solvers.session.session_step_solve`` with no warm
+    iterate calls the literal historical ``pcg_solve``, so the lowered
+    program must be the byte-identical flags-off executable — this
+    entry's fingerprint must EQUAL solve.jacobi_f64's (asserted by
+    tests/test_session.py on the committed ledger)."""
+    from poisson_tpu.solvers.pcg import _solve
+
+    a, b, rhs, aux = _setup("float64", False)
+    return _solve.lower(_problem(), False, 0, 0, 0.0, False,
+                        a, b, rhs, aux).as_text()
+
+
+def _build_session_warm_f64() -> str:
+    import numpy as np
+
+    from poisson_tpu.solvers.session import _solve_warm
+
+    p = _problem()
+    a, b, rhs, aux = _setup("float64", False)
+    w0 = np.zeros((p.M + 1, p.N + 1))
+    return _solve_warm.lower(p, False, a, b, rhs, aux, w0).as_text()
+
+
+def _heat_operands():
+    import numpy as np
+
+    from poisson_tpu.solvers.session import shifted_setup
+
+    p = _problem()
+    a, b, rhs0, aux = shifted_setup(p, None, "float64", False, 0.5)
+    u = np.zeros((p.M + 1, p.N + 1))
+    return p, a, b, rhs0, aux, np.asarray(0.5, np.float64), u
+
+
+def _build_session_heat_cold() -> str:
+    from poisson_tpu.solvers.session import _solve_shifted
+
+    p, a, b, rhs0, aux, m, u = _heat_operands()
+    return _solve_shifted.lower(p, False, False, a, b, rhs0, aux,
+                                m, u, u).as_text()
+
+
+def _build_session_heat_warm() -> str:
+    from poisson_tpu.solvers.session import _solve_shifted
+
+    p, a, b, rhs0, aux, m, u = _heat_operands()
+    return _solve_shifted.lower(p, False, True, a, b, rhs0, aux,
+                                m, u, u).as_text()
+
+
 _ALL_OFF = ("callbacks", "collectives", "mg")
 
 PROGRAMS: Tuple[ProgramSpec, ...] = (
@@ -249,6 +301,40 @@ PROGRAMS: Tuple[ProgramSpec, ...] = (
                     "PR 9 batch-polymorphism pin (2D HLO unchanged)",
         forbid=_ALL_OFF,
         build=_build_stencil_apply_A,
+    ),
+    ProgramSpec(
+        name="session.step_cold_f64",
+        description="a durable session's cold Poisson step (no warm "
+                    "iterate offered) — must lower to the byte-"
+                    "identical historical flags-off executable "
+                    "(fingerprint equals solve.jacobi_f64)",
+        forbid=_ALL_OFF,
+        build=_build_session_step_cold,
+    ),
+    ProgramSpec(
+        name="session.warm_f64",
+        description="the warm-started session step (restart_state "
+                    "from the previous iterate instead of zero init; "
+                    "same flags-off PCG body)",
+        forbid=_ALL_OFF,
+        build=_build_session_warm_f64,
+    ),
+    ProgramSpec(
+        name="session.heat_cold_f64",
+        description="one implicit-Euler heat step (A + m*I, transient "
+                    "RHS composed in-graph), zero init — the cold "
+                    "shifted-operator program every heat session "
+                    "stream compiles once",
+        forbid=_ALL_OFF,
+        build=_build_session_heat_cold,
+    ),
+    ProgramSpec(
+        name="session.heat_warm_f64",
+        description="the warm implicit-Euler heat step (restart from "
+                    "the previous time level) — the steady-state "
+                    "program of a converging transient stream",
+        forbid=_ALL_OFF,
+        build=_build_session_heat_warm,
     ),
 )
 
@@ -479,6 +565,12 @@ ATTRIBUTION_ONLY_DETAIL = {
     "krylov_fallbacks": "basis-cache telemetry snapshot",
     "deflated_bytes_per_iter_model": "analytic cost-model reading "
                                      "(obs.costs.krylov_deflated_cost)",
+    # durable-session A/B payload (cohort key carries detail.session /
+    # detail.warm_start; detail.steps is run length, not identity —
+    # steps/sec already normalizes by it)
+    "steps": "run length; the per-step rate is the record's value",
+    "session_ab": "both-arm A/B payload (cohort key carries "
+                  "detail.session and detail.warm_start)",
     # serve-mode latency/throughput payload beside the record's value
     "p95_seconds": "latency payload",
     "shed_rate": "outcome-rate payload (its own gauge exists)",

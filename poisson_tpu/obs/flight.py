@@ -80,6 +80,10 @@ POINT_RECOVERED = "recovered"      # re-enqueued off a dead worker/journal
 POINT_QUARANTINE = "quarantine"    # the worker serving this request fell
 POINT_PLACEMENT = "placement_remapped"  # recovered onto a different device
 #                                    (topology changed under the journal)
+POINT_SESSION_STEP = "session_step"     # one step of a durable session
+#                                    advanced (serve.session)
+POINT_WARM_FALLBACK = "warm_fallback"   # an offered warm start failed the
+#                                    validity gate — the step ran cold
 
 _ROOT_SPAN_ID = 0
 

@@ -231,7 +231,47 @@ Naming convention (dotted, low cardinality):
   partials, and slow successes are bad — they spend error budget);
   ``serve.degraded.slo_driven`` counts load-level decisions where the
   burn rate (not queue depth) chose the degradation rung
-  (``SLOPolicy.degrade_on_burn``).
+  (``SLOPolicy.degrade_on_burn``);
+- the ``session`` family — durable solver sessions (ordered streams of
+  dependent solves: :mod:`poisson_tpu.serve.session` hosts them,
+  :mod:`poisson_tpu.solvers.session` runs the steps):
+  ``session.opens`` / ``session.closes`` — session lifecycles started
+  and retired through :class:`~poisson_tpu.serve.session.SessionHost`;
+  ``session.steps`` — individual step solves executed (cold or warm;
+  read next to ``session.warm.hits`` for the warm fraction);
+  ``session.warm.hits`` — steps that ran the warm-started program
+  because the offered iterate passed the validity gate (fingerprint
+  drift within ``SessionPolicy.warm_drift_bound`` + residual sanity
+  within ``warm_residual_factor``); ``session.warm.fallbacks`` — steps
+  where a warm start was OFFERED and rejected by the gate, so the step
+  ran cold AUDIBLY (each emits a ``session.warm.fallback`` event with
+  the reason — ``family``, ``drift``, or ``residual``; a cold step
+  with nothing offered counts neither); ``session.setup.hits`` /
+  ``session.setup.misses`` — the shifted-operator (implicit-Euler
+  heat) setup cache, the same canvas-reuse story as
+  ``geom.cache.{hits,misses}`` one mass-shift deeper;
+  ``session.design.steps`` — shape-optimization design iterations
+  (one ``shape_gradient`` adjoint solve + parameter update each);
+  ``session.step.deadline_misses`` — steps whose wall time exceeded
+  ``SessionPolicy.step_deadline_seconds`` (the result is still
+  delivered; the miss is recorded on the session's flight trace);
+  ``session.slo.good`` / ``session.slo.bad`` — per-*session* SLO
+  verdicts at close (good = zero step errors and total wall within
+  ``SessionPolicy.slo_seconds``; the per-step ``serve.slo.*`` family
+  still scores each step individually); ``session.recovered`` —
+  sessions re-opened from the journal by ``--recover`` at the exact
+  committed step boundary (mid-step work re-enqueues cold, warm state
+  is never resurrected from unreplayed device memory);
+  ``session.recovery_errors`` — journaled sessions whose recovery
+  failed to reconstruct (malformed params/geometry — skipped audibly,
+  never half-restored); ``session.callback_errors`` — ``on_solution``
+  hooks that raised (the step's outcome is unaffected);
+  ``serve.session.shed_opens`` — session opens refused by admission
+  control (session-count cap or queue pressure past
+  ``SessionPolicy.shed_open_at``): the degradation ladder's session
+  rung sheds NEW sessions before it sheds steps of in-flight ones,
+  and each refusal is a typed ``serve.shed`` outcome plus a
+  ``session.shed_open`` event, never a silent drop.
 
 - the ``contracts`` family — the static program-contract checker
   (:mod:`poisson_tpu.contracts`, ``python -m poisson_tpu.contracts``):
@@ -288,8 +328,11 @@ counters and numeric gauges in Prometheus text format):
   ``bench.vs_baseline`` (single-solve mode), ``bench.batched_solves_per_sec``
   / ``bench.batched_speedup`` (``--batch``; the CLI's
   ``solve-batched --json`` stamps the same measurement as
-  ``batched.solves_per_sec``), and ``bench.verify_overhead_fraction``
-  (``--verify-every`` A/B overhead);
+  ``batched.solves_per_sec``), ``bench.verify_overhead_fraction``
+  (``--verify-every`` A/B overhead), and ``bench.session_steps_per_sec``
+  / ``bench.session_speedup`` (``--session`` — the durable-session
+  stream's throughput and its warm-vs-cold win over the same moving-
+  ellipse schedule);
 - ``serve.queue_depth`` / ``serve.load_level`` / ``serve.shed_rate`` /
   ``serve.lost_requests`` / ``serve.p99_latency_seconds`` — service
   health, refreshed on every drain; ``serve.latency_seconds`` is a

@@ -723,6 +723,86 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in krylov_parsed:
             return _fail(f"exposition lost the {prom_name} counter")
 
+    # 21. Durable solver sessions end to end (runs LAST, clean
+    # registry): an open session's warm-started steps beat its cold
+    # first step, abandoning the process state and replaying the
+    # journal re-opens the stream at the exact committed step boundary
+    # with the ledger invariant closed across the "crash", and the
+    # session_* counters survive the Prometheus exposition round trip.
+    from poisson_tpu.serve import SessionHost
+    from poisson_tpu.solvers.session import reset_session_cache
+
+    obs_metrics.reset()
+    reset_session_cache()
+    j21_path = os.path.join(out_dir, "session-selfcheck-journal.bin")
+    svc21 = SolveService(ServicePolicy(capacity=16),
+                         journal=SolveJournal(j21_path), seed=0)
+    host21 = SessionHost(svc21)
+    sess21 = host21.open("sc", problem, geometry=Ellipse())
+    if sess21 is None:
+        return _fail("session open was shed on an idle service")
+    outs21 = [host21.step(sess21, geometry=Ellipse(cx=5e-4 * k))
+              for k in range(3)]
+    if not all(o.converged for o in outs21):
+        return _fail(f"session steps did not converge: "
+                     f"{[o.kind for o in outs21]}")
+    warm_hits21 = obs_metrics.get("session.warm.hits")
+    if warm_hits21 < 2:
+        return _fail(f"warm starts missing: session.warm.hits="
+                     f"{warm_hits21} after 3 drifting steps")
+    cold_it21 = int(outs21[0].iterations)
+    warm_it21 = int(outs21[1].iterations)
+    if warm_it21 >= cold_it21:
+        return _fail(f"warm step did not beat cold: warm {warm_it21} "
+                     f"vs cold {cold_it21} iterations")
+    # The "crash": abandon the live service WITHOUT closing the
+    # session, then rebuild both halves from the journal — the
+    # per-request half (SolveService.recover) and the stream half
+    # (SessionHost.recover) — and finish the schedule.
+    del svc21, host21, sess21
+    svc21b = SolveService.recover(SolveJournal(j21_path),
+                                  ServicePolicy(capacity=16), seed=0)
+    host21b = SessionHost(svc21b)
+    rec21 = host21b.recover()
+    sess21b = next((s for s in rec21 if s.session_id == "sc"), None)
+    if sess21b is None:
+        return _fail("journal replay did not re-open session 'sc'")
+    if sess21b.next_step != 3 or sess21b.advanced != 2 \
+            or not sess21b.recovered or sess21b.generation != 2:
+        return _fail(
+            f"recovered session off its committed boundary: next_step="
+            f"{sess21b.next_step}, advanced={sess21b.advanced}, "
+            f"generation={sess21b.generation}")
+    if sess21b.warm is not None:
+        return _fail("recovery resurrected a warm iterate from "
+                     "unreplayed device state")
+    out21 = host21b.step(sess21b, geometry=Ellipse(cx=5e-4 * 3))
+    if not out21.converged:
+        return _fail(f"post-recovery step did not converge: {out21.kind}")
+    close21 = host21b.close(sess21b)
+    if obs_metrics.get("session.recovered") != 1 \
+            or close21["errors"] != 0:
+        return _fail(
+            f"recovery accounting off: session.recovered="
+            f"{obs_metrics.get('session.recovered')}, close={close21}")
+    adm21 = obs_metrics.get("serve.admitted")
+    done21 = (obs_metrics.get("serve.completed")
+              + obs_metrics.get("serve.errors")
+              + obs_metrics.get("serve.shed"))
+    if adm21 != 5 or adm21 != done21:
+        return _fail(
+            f"session ledger did not close across the crash: admitted="
+            f"{adm21}, completed+errors+shed={done21}")
+    session_parsed = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_session_opens",
+                      "poisson_tpu_session_steps",
+                      "poisson_tpu_session_warm_hits",
+                      "poisson_tpu_session_recovered",
+                      "poisson_tpu_session_closes",
+                      "poisson_tpu_session_slo_good"):
+        if prom_name not in session_parsed:
+            return _fail(f"exposition lost the {prom_name} counter")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -745,7 +825,9 @@ def run_selfcheck(out_dir: str) -> int:
           f"{contracts_report['counts']['ledger_programs']} ledger "
           f"programs, 0 findings), krylov memory ok "
           f"(cold {int(cold20.iterations)} -> warm "
-          f"{int(warm20.iterations)} it, {int(saved20)} saved) "
+          f"{int(warm20.iterations)} it, {int(saved20)} saved), "
+          f"solver sessions ok (warm {warm_it21} vs cold {cold_it21} "
+          f"it, boundary replay closed {int(adm21)}/{int(done21)}) "
           f"({out_dir})")
     return 0
 

@@ -63,9 +63,12 @@ from poisson_tpu.serve.placement import (
 from poisson_tpu.serve.journal import (
     JournalReplay,
     PendingRequest,
+    SessionReplay,
     SolveJournal,
     replay_journal,
+    replay_sessions,
 )
+from poisson_tpu.serve.session import SessionHost, SolveSession
 from poisson_tpu.serve.service import (
     SolveService,
     p99_exemplar,
@@ -90,6 +93,7 @@ from poisson_tpu.serve.types import (
     SCHED_CONTINUOUS,
     SCHED_DRAIN,
     ServicePolicy,
+    SessionPolicy,
     SHED_BREAKER_OPEN,
     SHED_DEADLINE_EXPIRED,
     SHED_QUEUE_FULL,
@@ -110,10 +114,12 @@ __all__ = [
     "PlacementError", "RetryPolicy",
     "RUNG_MESH", "RUNG_SHED", "RUNG_SINGLE",
     "SCHED_CONTINUOUS", "SCHED_DRAIN", "ServicePolicy",
+    "SessionHost", "SessionPolicy", "SessionReplay",
     "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED", "SHED_QUEUE_FULL",
     "SLOPolicy", "SolveJournal", "SolveRequest", "SolveService",
+    "SolveSession",
     "TransientDispatchError", "WORKER_DEAD", "WORKER_QUARANTINED",
     "WORKER_RUNNING", "Worker", "WorkerCrashError", "WorkerHangError",
     "WorkerPool", "elastic_plan", "p99_exemplar", "replay_journal",
-    "slowest_requests",
+    "replay_sessions", "slowest_requests",
 ]

@@ -52,10 +52,16 @@ from poisson_tpu.serve.types import SolveRequest
 SCHEMA = "poisson_tpu.serve.journal/1"
 
 # The request fields a submit record persists (everything a recovery
-# needs to rebuild the SolveRequest; ``on_chunk`` hooks are process
-# handles and deliberately do not survive — recovery notes their loss).
+# needs to rebuild the SolveRequest; ``on_chunk``/``on_solution`` hooks
+# are process handles and deliberately do not survive — recovery notes
+# their loss). Session identity (session_id/session_step/mass_shift)
+# replays so a recovered step re-enters the SAME stream; the warm-start
+# iterate (``warm_start``/``warm_geometry``) deliberately does NOT —
+# mid-step work is re-enqueued COLD, never resumed from unreplayed
+# device state.
 _REQUEST_FIELDS = ("rhs_gate", "dtype", "deadline_seconds", "chunk",
-                   "max_attempts", "device_id")
+                   "max_attempts", "device_id", "session_id",
+                   "session_step", "mass_shift")
 _PROBLEM_FIELDS = ("M", "N", "x_min", "x_max", "y_min", "y_max", "f_val",
                    "delta", "max_iter", "weighted_norm")
 
@@ -140,6 +146,103 @@ class SolveJournal:
             self._fh.close()
         except OSError:
             pass
+
+
+# Session lifecycle record kinds (poisson_tpu.serve.session): every
+# step transition of a durable session is journaled so ``--recover``
+# replays a killed process back to the exact step boundary.
+#
+# - ``session_open``  — the stream was admitted: identity, kind
+#   (poisson|heat|design), base geometry JSON, schedule parameters, and
+#   the session's flight trace id (adopt()-continued across crashes).
+# - ``session_step``  — step k was submitted, with its request id and
+#   warm-start PROVENANCE (``warm_from``: the step index the warm
+#   iterate came from, or -1 for a cold step) — never the iterate.
+# - ``session_advance`` — step k reached its typed outcome; the stream's
+#   committed boundary moves to k.
+# - ``session_close`` / ``session_shed`` — the stream's one terminal
+#   record (a shed open is terminal too).
+SESSION_RECORD_KINDS = ("session_open", "session_step",
+                        "session_advance", "session_close",
+                        "session_shed")
+
+
+@dataclasses.dataclass
+class SessionReplay:
+    """One session's journal truth (:func:`replay_sessions`)."""
+
+    session_id: str = ""
+    kind: str = "poisson"
+    trace_id: str = ""
+    t_open: float = 0.0
+    params: dict = dataclasses.field(default_factory=dict)
+    steps_submitted: int = 0          # highest step with a session_step
+    last_advanced: int = -1           # highest step with session_advance
+    advanced_geometry: Optional[str] = None  # geometry JSON at that step
+    closed: bool = False
+    shed: bool = False
+    generations: int = 1              # 1 + prior session recoveries
+
+    @property
+    def open(self) -> bool:
+        return not (self.closed or self.shed)
+
+
+def replay_sessions(path: str) -> Dict[str, SessionReplay]:
+    """Fold the journal's ``session_*`` records into per-session truth:
+    which streams are still open, the exact step boundary each one
+    committed to (``last_advanced``), and the schedule parameters a
+    recovery needs to continue the stream. Torn records are skipped
+    like :func:`replay_journal` (the per-request ledger half already
+    counts them)."""
+    sessions: Dict[str, SessionReplay] = {}
+    scratch = JournalReplay()
+    try:
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return sessions
+    for lineno, line in enumerate(lines, start=1):
+        rec = _parse_line(line, lineno, scratch)
+        if rec is None or rec.get("kind") not in SESSION_RECORD_KINDS:
+            continue
+        sid = str(rec.get("session_id", ""))
+        kind = rec["kind"]
+        if kind == "session_open":
+            prior = sessions.get(sid)
+            srep = SessionReplay(
+                session_id=sid,
+                kind=str(rec.get("session_kind", "poisson")),
+                trace_id=str(rec.get("trace_id", "")),
+                t_open=float(rec.get("t", 0.0)),
+                params=dict(rec.get("params") or {}),
+            )
+            if prior is not None:
+                # A recovery re-opened the stream: keep the committed
+                # boundary, bump the generation (flight span offsets).
+                srep.steps_submitted = prior.steps_submitted
+                srep.last_advanced = prior.last_advanced
+                srep.advanced_geometry = prior.advanced_geometry
+                srep.generations = prior.generations + 1
+                srep.trace_id = srep.trace_id or prior.trace_id
+            sessions[sid] = srep
+            continue
+        srep = sessions.get(sid)
+        if srep is None:
+            continue
+        if kind == "session_step":
+            srep.steps_submitted = max(srep.steps_submitted,
+                                       int(rec.get("step", 0)))
+        elif kind == "session_advance":
+            step = int(rec.get("step", 0))
+            if step > srep.last_advanced:
+                srep.last_advanced = step
+                srep.advanced_geometry = rec.get("geometry")
+        elif kind == "session_close":
+            srep.closed = True
+        elif kind == "session_shed":
+            srep.shed = True
+    return sessions
 
 
 @dataclasses.dataclass

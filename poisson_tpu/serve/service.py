@@ -74,6 +74,7 @@ from poisson_tpu.obs.flight import (
     POINT_QUARANTINE,
     POINT_RECOVERED,
     POINT_RETRY,
+    POINT_WARM_FALLBACK,
     SPAN_BACKOFF,
     SPAN_QUEUE,
     SPAN_RESIDENT,
@@ -344,6 +345,36 @@ class SolveService:
                         "krylov deflation does not ride the chunked/"
                         "deadline path yet — drop deadline_seconds/"
                         "chunk or deflation")
+        # Session-step validation, same loud-at-admission contract
+        # (serve.session): a session step runs the fused session
+        # programs — warm restart / implicit-Euler shift — which do not
+        # compose with the chunked driver, non-jacobi preconditioner
+        # bodies, or Krylov block/deflation memory; and the session
+        # fields are meaningless outside a session.
+        if request.session_id is not None:
+            if kp != DEFAULT_KRYLOV_POLICY:
+                raise ValueError(
+                    "session steps do not compose with krylov "
+                    f"block/deflation (session {request.session_id!r}) "
+                    "— the warm-start seam IS the session's solver "
+                    "memory")
+            if pre not in (None, "jacobi"):
+                raise ValueError(
+                    "session steps run the fused jacobi session "
+                    f"programs only (preconditioner={pre!r})")
+            if request.chunk is not None:
+                raise ValueError(
+                    "session steps are fused single-program solves — "
+                    "per-step deadlines are checked at step boundaries; "
+                    "drop chunk")
+        elif (request.warm_start is not None
+              or request.warm_geometry is not None
+              or request.session_step is not None
+              or request.mass_shift):
+            raise ValueError(
+                "warm_start/warm_geometry/session_step/mass_shift "
+                "require session_id — session semantics do not attach "
+                "to per-request traffic")
         # A placement pin outside the fleet topology — or to a healthy
         # device no worker is bound to (the pin could never be served)
         # — is a caller bug, loud at admission (same contract as a
@@ -949,6 +980,7 @@ class SolveService:
                 or entry.request.chunk is not None
                 or entry.escalate
                 or entry.request.device_id is not None
+                or entry.request.session_id is not None
                 or self._krylov(entry.request).deflation
                 or (entry.request.geometry is not None
                     and self._precond(entry.request) == "mg"))
@@ -1031,6 +1063,7 @@ class SolveService:
         kp = self._krylov(entry.request)
         return (entry.request.chunk is None and not entry.escalate
                 and entry.request.device_id is None
+                and entry.request.session_id is None
                 and kp.mode == "independent" and not kp.deflation
                 and not (entry.request.geometry is not None
                          and self._precond(entry.request) == "mg"))
@@ -1614,6 +1647,9 @@ class SolveService:
             solo_problem = problem.with_(
                 f_val=problem.f_val * req.rhs_gate)
         rid = req.request_id
+        if req.session_id is not None:
+            return self._dispatch_session(entry, problem, dtype, did,
+                                          t_disp)
         verify_every, verify_tol = self._verify_params([entry])
         self._count_defensive_verify(verify_every)
         kp = self._krylov(req)
@@ -1706,6 +1742,69 @@ class SolveService:
             float(np.max(np.asarray(result.diff))),
             restarts=int(getattr(result, "restarts", 0) or 0),
             cap=problem.iteration_cap, co_ids=set(),
+        )
+
+    def _dispatch_session(self, entry: _Entry, problem, dtype, did: str,
+                          t_disp: float) -> bool:
+        """One session step (``serve.session``): a fused solve through
+        the warm-start seam. The warm iterate rides the request
+        (``warm_start`` — process memory, never the journal: a replayed
+        step arrives with the field at its default and runs COLD), the
+        validity gate lives in the solver layer
+        (:func:`solvers.session.session_step_solve`), and a gate
+        fallback is audible here too (``warm_fallback`` flight point on
+        the step's own trace). Per-step deadlines are enforced at step
+        boundaries — an expired deadline sheds the step in the queue
+        like any request; a step that finishes past its deadline still
+        returns its (correct) result, with the miss counted
+        (``session.step.deadline_misses``) and pointed on the trace."""
+        from poisson_tpu.solvers.pcg import FLAG_CONVERGED
+        from poisson_tpu.solvers.session import session_step_solve
+
+        req = entry.request
+        rid = req.request_id
+        sp = self.policy.session
+        result, info = session_step_solve(
+            problem, dtype=dtype, geometry=req.geometry,
+            warm=req.warm_start, warm_geometry=req.warm_geometry,
+            mass_shift=req.mass_shift,
+            # The previous iterate is the implicit-Euler step's uⁿ —
+            # transient DATA, not just a guess (the gate only decides
+            # whether it also seeds the restart).
+            u_prev=(req.warm_start if req.mass_shift else None),
+            rhs_gate=req.rhs_gate,
+            drift_bound=sp.warm_drift_bound,
+            residual_factor=sp.warm_residual_factor,
+        )
+        if not info["warm_used"] and req.warm_start is not None:
+            self._flight.point(rid, POINT_WARM_FALLBACK,
+                               reason=info["fallback"],
+                               step=req.session_step,
+                               session=str(req.session_id))
+        secs = max(0.0, self._clock() - t_disp)
+        iters = int(result.iterations)
+        flag = int(result.flag)
+        if flag == FLAG_CONVERGED and req.on_solution is not None:
+            # Hand the converged iterate back to the session host (the
+            # next step's warm-start source). A throwing hook must not
+            # void the outcome — the step solved; the hook is the
+            # caller's code.
+            try:
+                req.on_solution(np.asarray(result.w))
+            except Exception:
+                obs.inc("session.callback_errors")
+        if entry.deadline is not None and entry.deadline.expired():
+            obs.inc("session.step.deadline_misses")
+            self._flight.point(rid, POINT_DEADLINE,
+                               where="session_step",
+                               elapsed=round(entry.deadline.elapsed(), 4))
+        self._flight.add_step(rid, secs, iters, secs if iters else 0.0,
+                              did, k=iters)
+        self._flight.end(rid, SPAN_RESIDENT, iterations=iters,
+                         warm=info["warm_used"])
+        return self._classify_member(
+            entry, flag, iters, float(np.max(np.asarray(result.diff))),
+            restarts=0, cap=problem.iteration_cap, co_ids=set(),
         )
 
     # -- outcome classification ----------------------------------------

@@ -125,6 +125,25 @@ class SolveRequest:
     # deflation combined with the chunked/deadline path is a loud
     # ValueError.
     krylov: Optional[KrylovPolicy] = None
+    # Session stream identity (:mod:`poisson_tpu.serve.session`): a
+    # request carrying ``session_id`` is step ``session_step`` of an
+    # ordered stream of dependent solves. Session steps dispatch solo
+    # (the warm-start seam is a single-request program) and journal
+    # their session fields, so a recovery re-enqueues a killed step
+    # into the SAME stream. ``warm_start`` is the previous step's
+    # w-space iterate and ``warm_geometry`` the spec it solved — device
+    # state, deliberately NEVER journaled: a recovered step always runs
+    # cold (unreplayed device state is not evidence). ``mass_shift`` is
+    # the implicit-Euler 1/Δt operator shift (0 = plain Poisson step).
+    # ``on_solution`` hands the step's solution grid back to the
+    # session host for the next step's warm start — a process handle,
+    # like ``on_chunk`` it does not survive a crash (audibly).
+    session_id: Optional[str] = None
+    session_step: Optional[int] = None
+    mass_shift: float = 0.0
+    warm_start: Optional[object] = None
+    warm_geometry: Optional[object] = None
+    on_solution: Optional[Callable] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -293,6 +312,34 @@ class SLOPolicy:
     burn_degrade_thresholds: tuple = (2.0, 6.0, 14.0)
 
 
+@dataclasses.dataclass(frozen=True)
+class SessionPolicy:
+    """Durable-session knobs (:mod:`poisson_tpu.serve.session`).
+
+    ``max_sessions`` bounds concurrently-open sessions (an open beyond
+    it sheds, typed). ``shed_open_at`` is the session rung of the
+    degradation ladder: a NEW session open sheds once queue depth
+    reaches this fraction of capacity — deliberately *below* the
+    queue-full threshold that sheds individual steps, because a
+    half-finished stream is sunk cost (shed new sessions before steps
+    of in-flight ones). ``warm_drift_bound``/``warm_residual_factor``
+    parameterize the warm-start validity gate
+    (``solvers.session.warm_validity``/residual sanity — a failing gate
+    falls back cold, audibly). ``step_deadline_seconds`` is the
+    default per-step deadline (enforced at step boundaries — the fused
+    session programs do not chunk; a miss counts
+    ``session.step.deadline_misses``). ``slo_seconds`` is the
+    per-session wall objective scored at close on the session's own
+    flight trace (``session.slo.{good,bad}``)."""
+
+    max_sessions: int = 8
+    shed_open_at: float = 0.75
+    warm_drift_bound: float = 0.05
+    warm_residual_factor: float = 100.0
+    step_deadline_seconds: Optional[float] = None
+    slo_seconds: float = 60.0
+
+
 # Scheduling modes (ServicePolicy.scheduling):
 SCHED_DRAIN = "drain"            # PR 5 batch-drain: dispatch, wait, repeat
 SCHED_CONTINUOUS = "continuous"  # lane table + refill state machine
@@ -353,6 +400,12 @@ class ServicePolicy:
     batchable dispatches (``…:blk`` cohorts), ``deflation=True`` routes
     every request through the fingerprint-keyed solver memory
     (``…:defl`` cohorts, solo dispatch, basis-holder sticky routing).
+
+    ``session`` governs durable solver sessions
+    (:class:`SessionPolicy` — ``poisson_tpu.serve.session``): open
+    bounds, the shed-new-sessions-first degradation rung, warm-start
+    validity, per-step deadlines, and the per-session SLO. The defaults
+    change nothing for session-free traffic.
     """
 
     capacity: int = 64
@@ -369,3 +422,4 @@ class ServicePolicy:
     fleet: FleetPolicy = FleetPolicy()
     integrity: IntegrityPolicy = IntegrityPolicy()
     krylov: KrylovPolicy = KrylovPolicy()
+    session: SessionPolicy = SessionPolicy()

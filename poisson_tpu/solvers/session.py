@@ -1,0 +1,413 @@
+"""Session-step solvers: warm-started and implicit-Euler-shifted solves.
+
+A *session* (``poisson_tpu.serve.session``) is an ordered stream of
+dependent solves against slowly-varying canvases — heat-equation
+implicit-Euler time stepping, or a shape-design gradient loop. The
+stream's whole performance case is that consecutive operators are
+*nearby*: the previous step's iterate is an excellent initial guess, so
+each step restarts CG from it (:func:`solvers.pcg.restart_state` — the
+same primitive the recovery driver uses) instead of from zero.
+
+Correctness discipline, in order of precedence:
+
+- **The cold path is the historical program.** A step without a usable
+  warm iterate (first step, validity-gate fallback, crash recovery)
+  delegates to the literal :func:`solvers.pcg.pcg_solve` → ``_solve``
+  executable — byte-identical HLO, pinned by the contracts ledger
+  (``session.step_cold_f64`` asserts fingerprint equality with
+  ``solve.jacobi_f64``).
+- **Warm starts are gated, and fall back audibly.** A warm iterate is
+  only trusted when (a) the geometry drift between the iterate's
+  operator and this step's operator is bounded (:func:`warm_drift` —
+  fingerprint equality, or per-parameter drift within
+  ``drift_bound`` for closed-form ellipses) and (b) one eager stencil
+  application confirms the warm residual is finite and within
+  ``residual_factor`` of the RHS scale. A rejected warm start counts
+  ``session.warm.fallbacks`` (+ a ``session.warm.fallback`` event with
+  the reason) and runs cold — converging fast against the *wrong*
+  operator is the failure mode this gate exists to prevent.
+- **Warm iterates never cross a crash.** The serve layer journals which
+  step a warm start came from, but never the iterate itself: recovery
+  re-enqueues mid-step work cold (unreplayed device state is not
+  evidence — the PR 14 deflation-cache precedent).
+
+The implicit-Euler heat step solves ``(A + m·I) u⁺ = B + m·uⁿ`` on the
+interior, ``m = 1/Δt`` (Glowinski/Pan/Périaux's moving-domain setting,
+PAPERS.md). The mass shift CANNOT ride the coefficient canvases — a/b
+are *edge* blend coefficients and ``apply_A`` has no zeroth-order term
+— so the shifted step gets its own jitted programs
+(:func:`_solve_shifted`): matvec ``A·w + m·w`` on the interior, Jacobi
+diagonal ``D + m``, and for the scaled system a recomputed
+``(D + m)^{-1/2}`` symmetrizer. Both shifted programs are ledgered
+(``session.heat_cold_f64`` / ``session.heat_warm_f64``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from poisson_tpu import obs
+from poisson_tpu.config import Problem
+from poisson_tpu.geometry.dsl import Ellipse, fingerprint_of
+from poisson_tpu.models.fictitious_domain import build_fields
+from poisson_tpu.ops.stencil import apply_A, diag_D, interior, pad_interior
+from poisson_tpu.solvers.pcg import (
+    PCGResult,
+    init_state,
+    make_pcg_body,
+    pcg_solve,
+    resolve_dtype,
+    resolve_scaled,
+    restart_state,
+    scaled_single_device_ops,
+    single_device_ops,
+    solve_setup,
+)
+
+# Warm-validity defaults (overridable per call / via SessionPolicy):
+# geometry parameter drift beyond this bound means the warm iterate
+# solved a meaningfully different operator — restarting from it could
+# converge to δ against a stale A before the true residual recovers.
+DEFAULT_DRIFT_BOUND = 0.05
+# One eager stencil application sanity-checks the warm guess: its true
+# residual must be finite and within this factor of the RHS scale
+# (catches NaN-poisoned iterates and grid/problem mismatches the drift
+# bound cannot see).
+DEFAULT_RESIDUAL_FACTOR = 100.0
+
+
+# -- warm-start validity -------------------------------------------------
+
+def warm_drift(prev_spec, spec):
+    """Geometry drift between the operator a warm iterate solved and the
+    operator this step will solve. Returns a non-negative float, or
+    ``None`` when the pair is incomparable (different families, sampled
+    specs) — incomparable means *invalid*, never "assume close".
+
+    Fingerprint equality (including the None/None reference-ellipse
+    pair) is drift 0.0; closed-form ellipse pairs compare per-parameter
+    (max over |Δcx|, |Δcy|, |Δrx|, |Δry|) — exactly the parameters the
+    session's design loop / moving-domain schedule varies.
+    """
+    if fingerprint_of(prev_spec) == fingerprint_of(spec):
+        return 0.0
+    if isinstance(prev_spec, Ellipse) and isinstance(spec, Ellipse):
+        return max(
+            abs(float(spec.cx) - float(prev_spec.cx)),
+            abs(float(spec.cy) - float(prev_spec.cy)),
+            abs(float(spec.rx) - float(prev_spec.rx)),
+            abs(float(spec.ry) - float(prev_spec.ry)),
+        )
+    return None
+
+
+def warm_validity(prev_spec, spec,
+                  drift_bound: float = DEFAULT_DRIFT_BOUND):
+    """(valid, reason) for the geometry half of the warm gate. Reasons:
+    ``""`` (valid), ``"family"`` (incomparable specs), ``"drift"``
+    (parameter drift beyond the bound)."""
+    d = warm_drift(prev_spec, spec)
+    if d is None:
+        return False, "family"
+    if d > float(drift_bound):
+        return False, "drift"
+    return True, ""
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _residual_norms(problem: Problem, scaled: bool, a, b, rhs, aux,
+                    w0, m):
+    """Fused ‖B − (A + m·I)w₀‖ / ‖B‖ for the warm gate — one jitted
+    program instead of ~20 eager dispatches (the gate runs on EVERY
+    warm-offered step, so its overhead prices the whole session).
+    ``m`` is a traced scalar: the Poisson gate passes 0.0 and shares
+    the compiled program with the heat gate."""
+    Aw = apply_A(w0, a, b, problem.h1, problem.h2)
+    Aw = Aw + m * pad_interior(interior(w0))
+    r0 = rhs - (Aw * aux if scaled else Aw)
+    return jnp.sqrt(jnp.sum(r0 * r0)), jnp.sqrt(jnp.sum(rhs * rhs))
+
+
+def _residual_ok(problem: Problem, a, b, rhs, aux, scaled: bool, w0,
+                 mass_shift: float,
+                 factor: float = DEFAULT_RESIDUAL_FACTOR) -> bool:
+    """Residual sanity on a warm initial guess: one stencil
+    application (jitted — :func:`_residual_norms`). ``rhs`` is the
+    system RHS in the system the solve runs (scaled b̃ when
+    ``scaled``); ``w0`` is always a w-space grid. The w-space residual
+    maps into the scaled system by one multiply with ``aux``
+    (r̃ = sc·(B − A·w)), so both systems share the check."""
+    r0, bnorm = _residual_norms(problem, bool(scaled), a, b, rhs, aux,
+                                jnp.asarray(w0, rhs.dtype),
+                                jnp.asarray(float(mass_shift), rhs.dtype))
+    r0n = float(r0)
+    bn = float(bnorm)
+    return bool(np.isfinite(r0n) and r0n <= float(factor) * max(bn, 1e-300))
+
+
+# -- jitted session programs --------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _solve_warm(problem: Problem, scaled: bool, a, b, rhs, aux,
+                w0) -> PCGResult:
+    """Warm-started single-device solve: the historical flags-off PCG
+    iteration body, initialized by :func:`restart_state` from the
+    w-space iterate ``w0`` instead of zero. Same operands contract as
+    ``_solve`` plus the guess; ledgered as ``session.warm_f64``."""
+    ops = (scaled_single_device_ops(problem, a, b, aux) if scaled
+           else single_device_ops(problem, a, b, aux))
+    if scaled:
+        # The scaled system iterates y = D^{1/2}w; aux is D^{-1/2} with a
+        # zero ring, so the ring maps to 0 rather than dividing by it.
+        y0 = jnp.where(aux > 0, w0 / jnp.where(aux > 0, aux, 1.0), 0.0)
+    else:
+        y0 = w0
+    body = make_pcg_body(ops, delta=problem.delta,
+                         weighted_norm=problem.weighted_norm,
+                         h1=problem.h1, h2=problem.h2)
+
+    def cond(s):
+        return (~s.done) & (s.k < problem.iteration_cap)
+
+    s = lax.while_loop(cond, body, restart_state(ops, rhs, y0))
+    w = s.w * aux if scaled else s.w
+    return PCGResult(w=w, iterations=s.k, diff=s.diff,
+                     residual_dot=s.zr, flag=s.flag)
+
+
+def _shifted_ops(problem: Problem, a, b, aux, m, scaled: bool):
+    """PCGOps for the implicit-Euler operator ``A + m·I`` (interior).
+
+    The mass shift cannot live in the a/b canvases (edge coefficients —
+    ``apply_A`` has no zeroth-order term), so the matvec adds
+    ``m·w`` on the interior explicitly. ``aux`` must already embed the
+    SHIFTED diagonal: ``D + m`` (unscaled) or ``(D + m)^{-1/2}``
+    (scaled) — :func:`shifted_setup` builds exactly that."""
+    h1, h2 = problem.h1, problem.h2
+    if not scaled:
+        base = single_device_ops(problem, a, b, aux)
+        return base._replace(
+            apply_A=lambda p: (apply_A(p, a, b, h1, h2)
+                               + m * pad_interior(interior(p))))
+    base = scaled_single_device_ops(problem, a, b, aux)
+
+    def apply_shifted(p):
+        w = p * aux
+        return (apply_A(w, a, b, h1, h2)
+                + m * pad_interior(interior(w))) * aux
+
+    return base._replace(apply_A=apply_shifted)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _solve_shifted(problem: Problem, scaled: bool, warm: bool,
+                   a, b, rhs0, aux, m, u_prev, w0) -> PCGResult:
+    """One implicit-Euler step ``(A + m·I) u⁺ = B + m·uⁿ``, jitted.
+
+    ``rhs0`` is the UNSCALED forcing canvas B (the per-step transient
+    term and, in the scaled system, the symmetrization are composed
+    in-graph so one program serves every step of the session);
+    ``u_prev`` is uⁿ (w-space); ``warm``, a trace-time constant, selects
+    restart-from-``w0`` vs the historical zero init. Ledgered as
+    ``session.heat_{cold,warm}_f64``."""
+    rhs = rhs0 + m * pad_interior(interior(u_prev))
+    if scaled:
+        rhs = rhs * aux
+    ops = _shifted_ops(problem, a, b, aux, m, scaled)
+    body = make_pcg_body(ops, delta=problem.delta,
+                         weighted_norm=problem.weighted_norm,
+                         h1=problem.h1, h2=problem.h2)
+
+    def cond(s):
+        return (~s.done) & (s.k < problem.iteration_cap)
+
+    if warm:
+        y0 = (jnp.where(aux > 0, w0 / jnp.where(aux > 0, aux, 1.0), 0.0)
+              if scaled else w0)
+        init = restart_state(ops, rhs, y0)
+    else:
+        init = init_state(ops, rhs)
+    s = lax.while_loop(cond, body, init)
+    w = s.w * aux if scaled else s.w
+    return PCGResult(w=w, iterations=s.k, diff=s.diff,
+                     residual_dot=s.zr, flag=s.flag)
+
+
+# -- shifted-operator setup cache ---------------------------------------
+
+# Keyed like geometry_setup plus the mass shift: a session's heat steps
+# share one setup (and one compiled program) for the whole stream.
+_SHIFT_CACHE: dict = {}
+_SHIFT_CACHE_CAP = 32
+
+
+def reset_session_cache() -> None:
+    """Drop the shifted-setup cache (tests / chaos registry resets)."""
+    _SHIFT_CACHE.clear()
+
+
+def shifted_setup(problem: Problem, geometry, dtype_name: str,
+                  scaled: bool, mass_shift: float):
+    """Device-resident (a, b, rhs0, aux) for the shifted operator
+    ``A + m·I``: the session analog of ``solvers.pcg.host_setup``.
+
+    Unlike ``host_setup``/``geometry_setup``, ``rhs0`` here is the
+    UNSCALED forcing canvas B — the transient term ``m·uⁿ`` changes
+    every step, so the scaled system's b̃ is composed inside
+    :func:`_solve_shifted` rather than baked into the cache. ``aux``
+    embeds the SHIFTED diagonal (``D + m`` unscaled,
+    ``(D + m)^{-1/2}`` scaled), derived on the host in fp64 like every
+    setup in this repo. Counts ``session.setup.hits``/``misses``."""
+    m = float(mass_shift)
+    key = (problem, fingerprint_of(geometry), dtype_name, bool(scaled), m)
+    hit = _SHIFT_CACHE.get(key)
+    if hit is not None:
+        obs.inc("session.setup.hits")
+        return hit
+    obs.inc("session.setup.misses")
+    if geometry is None:
+        a64, b64, rhs64 = build_fields(problem, dtype=np.float64, xp=np)
+    else:
+        from poisson_tpu.geometry.canvas import build_geometry_fields
+
+        a64, b64, rhs64 = build_geometry_fields(problem, geometry)
+    dm = diag_D(a64, b64, problem.h1, problem.h2) + m
+    aux64 = np.pad(1.0 / np.sqrt(dm), 1) if scaled else np.pad(dm, 1)
+    dt = jnp.dtype(dtype_name)
+    out = (jnp.asarray(a64, dt), jnp.asarray(b64, dt),
+           jnp.asarray(rhs64, dt), jnp.asarray(aux64, dt))
+    if len(_SHIFT_CACHE) >= _SHIFT_CACHE_CAP:
+        _SHIFT_CACHE.pop(next(iter(_SHIFT_CACHE)))
+    _SHIFT_CACHE[key] = out
+    return out
+
+
+# -- the session step entry point ---------------------------------------
+
+def session_step_solve(problem: Problem, dtype=None, scaled=None,
+                       geometry=None, warm=None, warm_geometry=None,
+                       mass_shift: float = 0.0, u_prev=None,
+                       rhs_gate=None,
+                       drift_bound: float = DEFAULT_DRIFT_BOUND,
+                       residual_factor: float = DEFAULT_RESIDUAL_FACTOR):
+    """One session step. Returns ``(PCGResult, info)`` where ``info`` is
+    ``{"warm_used": bool, "fallback": reason}``.
+
+    ``mass_shift == 0`` is a Poisson step of the (possibly moved)
+    domain; with a valid ``warm`` iterate it runs :func:`_solve_warm`,
+    otherwise it delegates to the literal :func:`pcg_solve` — the
+    byte-identical historical executable. ``mass_shift = 1/Δt > 0`` is
+    one implicit-Euler heat step with transient RHS ``B + m·uⁿ``
+    (``u_prev``; zero when omitted — a cold start from rest).
+
+    ``warm`` is the previous step's w-space solution grid;
+    ``warm_geometry`` is the spec that solution solved (the validity
+    gate's drift input). An invalid warm start runs cold and is audible:
+    ``session.warm.fallbacks`` + a reasoned ``session.warm.fallback``
+    event. A *used* warm start counts ``session.warm.hits``.
+    """
+    dtype_name = resolve_dtype(dtype)
+    use_scaled = resolve_scaled(scaled, dtype_name)
+    m = float(mass_shift)
+    if m < 0.0:
+        raise ValueError(f"mass_shift must be >= 0, got {m} "
+                         "(it is 1/dt of an implicit-Euler step)")
+    obs.inc("session.steps")
+
+    def _gate(a, b, rhs, aux):
+        """(warm_ok, w0, reason) against the already-built canvases."""
+        if warm is None:
+            return False, None, "none"
+        ok, reason = warm_validity(warm_geometry, geometry, drift_bound)
+        if not ok:
+            return False, None, reason
+        w0 = jnp.asarray(warm, rhs.dtype)
+        if w0.shape != rhs.shape:
+            return False, None, "shape"
+        if not _residual_ok(problem, a, b, rhs, aux, use_scaled, w0,
+                            m, residual_factor):
+            return False, None, "residual"
+        return True, w0, ""
+
+    def _audit(used: bool, reason: str) -> dict:
+        if used:
+            obs.inc("session.warm.hits")
+        elif warm is not None:
+            # A warm start was OFFERED and rejected: the audible
+            # fallback contract. (warm=None is a deliberate cold step,
+            # not a fallback.)
+            obs.inc("session.warm.fallbacks")
+            obs.event("session.warm.fallback", reason=reason,
+                      geometry=fingerprint_of(geometry),
+                      warm_geometry=fingerprint_of(warm_geometry))
+        return {"warm_used": used, "fallback": "" if used else reason}
+
+    if m != 0.0:
+        a, b, rhs0, aux = shifted_setup(problem, geometry, dtype_name,
+                                        use_scaled, m)
+        if rhs_gate is not None:
+            rhs0 = rhs0 * jnp.asarray(rhs_gate, rhs0.dtype)
+        up = (jnp.zeros_like(rhs0) if u_prev is None
+              else jnp.asarray(u_prev, rhs0.dtype))
+        # Gate against the true transient RHS (B + m·uⁿ, scaled into the
+        # solve's system) — the residual check must see the operator and
+        # RHS the solve will actually run.
+        rhs_step = rhs0 + jnp.asarray(m, rhs0.dtype) * pad_interior(
+            interior(up))
+        if use_scaled:
+            rhs_step = rhs_step * aux
+        used, w0, reason = _gate(a, b, rhs_step, aux)
+        md = jnp.asarray(m, rhs0.dtype)
+        result = _solve_shifted(
+            problem, use_scaled, used, a, b, rhs0, aux, md, up,
+            w0 if used else jnp.zeros_like(rhs0))
+        return result, _audit(used, reason)
+
+    a, b, rhs, aux = solve_setup(problem, dtype_name, use_scaled,
+                                 geometry=geometry)
+    if rhs_gate is not None:
+        rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
+    used, w0, reason = _gate(a, b, rhs, aux)
+    if used:
+        result = _solve_warm(problem, use_scaled, a, b, rhs, aux, w0)
+        return result, _audit(True, "")
+    # Cold path: the literal historical entry point — byte-identical
+    # executable (ledger: session.step_cold_f64 == solve.jacobi_f64).
+    result = pcg_solve(problem, dtype=dtype_name, scaled=use_scaled,
+                       rhs_gate=rhs_gate, geometry=geometry)
+    return result, _audit(False, reason)
+
+
+def design_step(problem: Problem, params, target, lr: float,
+                dtype=None, scaled=None):
+    """One gradient-descent step of the server-driven shape-design loop.
+
+    ``params`` is a dict with keys among ``cx, cy, rx, ry`` (the
+    differentiable ellipse parameters — ``geometry.canvas.traced_
+    fields``); ``target`` is the solution grid to match; the loss is the
+    mean squared interior mismatch. Returns ``(new_params, loss,
+    grads)`` — one forward solve + one implicit adjoint solve
+    (:func:`solvers.adjoint.shape_gradient`), whatever the iteration
+    counts. The serve session's ``kind="design"`` steps call this."""
+    from poisson_tpu.solvers.adjoint import shape_gradient
+
+    target = jnp.asarray(target)
+
+    def spec_fn(p):
+        return Ellipse(cx=p["cx"], cy=p["cy"], rx=p["rx"], ry=p["ry"])
+
+    def loss_fn(w):
+        d = interior(w) - interior(target)
+        return jnp.mean(d * d)
+
+    loss, grads = shape_gradient(problem, spec_fn, params, loss_fn,
+                                 dtype=dtype, scaled=scaled)
+    new_params = {k: float(params[k]) - float(lr) * float(grads[k])
+                  for k in params}
+    obs.inc("session.design.steps")
+    return new_params, float(loss), {k: float(v) for k, v in grads.items()}

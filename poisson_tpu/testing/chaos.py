@@ -199,11 +199,13 @@ def _reset_registries() -> None:
     from poisson_tpu.krylov.recycle import reset_krylov_cache
     from poisson_tpu.obs import metrics
     from poisson_tpu.solvers.batched import reset_bucket_cache
+    from poisson_tpu.solvers.session import reset_session_cache
 
     metrics.reset()
     reset_bucket_cache()
     reset_geometry_cache()
     reset_krylov_cache()
+    reset_session_cache()
 
 
 def _finish(name: str, seed: int, checks: dict, detail: dict) -> dict:
@@ -1733,6 +1735,205 @@ def _deflation_stale_basis(seed: int) -> dict:
     }, {"iterations": {o.request_id: o.iterations for o in outs},
         "cache": cache_stats(),
         "iterations_saved": _counter("krylov.iterations_saved")})
+
+
+@scenario("session-kill-recover-subprocess", group="session")
+def _session_kill_recover_subprocess(seed: int) -> dict:
+    """The session acceptance drill: kill ``python -m poisson_tpu
+    session`` mid-dispatch of step 3 (exit 75 — the step's submit is in
+    the journal, its outcome is not), restart with ``--recover``, and
+    assert from the two emitted metrics snapshots plus the journal that
+    the merged ledger closes across the kill with zero lost and zero
+    duplicated steps, the stream re-opened at the exact committed
+    boundary, and the recovered process finished the schedule COLD for
+    the mid-step work (warm iterates never cross a crash)."""
+    import subprocess
+    import sys
+
+    from poisson_tpu.serve.journal import replay_journal, replay_sessions
+
+    with tempfile.TemporaryDirectory(prefix="poisson-session-") as td:
+        journal = os.path.join(td, "session.journal")
+        a_metrics = os.path.join(td, "metrics-a.json")
+        b_metrics = os.path.join(td, "metrics-b.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        base = [sys.executable, "-m", "poisson_tpu", "session",
+                "40", "40", "--steps", "6", "--journal", journal,
+                "--seed", str(seed), "--json"]
+        phase_a = subprocess.run(
+            base + ["--kill-after", "3", "--metrics-out", a_metrics],
+            capture_output=True, text=True, timeout=240, env=env)
+        phase_b = subprocess.run(
+            base + ["--recover", "--metrics-out", b_metrics],
+            capture_output=True, text=True, timeout=240, env=env)
+
+        def counters(path):
+            try:
+                with open(path) as fh:
+                    return json.load(fh).get("counters", {})
+            except (OSError, ValueError):
+                return {}
+
+        ca, cb = counters(a_metrics), counters(b_metrics)
+
+        def terminated(c):
+            return (c.get("serve.completed", 0) + c.get("serve.errors", 0)
+                    + c.get("serve.shed", 0))
+
+        # Root + 6 steps = 7 admissions across both lives.
+        admitted = ca.get("serve.admitted", 0) + cb.get("serve.admitted", 0)
+        done = terminated(ca) + terminated(cb)
+        final = replay_journal(journal)
+        srep = replay_sessions(journal).get("cli")
+        step_ids = [f"cli#{k:04d}" for k in range(6)]
+        detail = {
+            "phase_a_rc": phase_a.returncode,
+            "phase_b_rc": phase_b.returncode,
+            "admitted": admitted, "terminated": done,
+            "terminated_before_kill": terminated(ca),
+            "recovered": cb.get("serve.recovered", 0),
+            "warm_hits_a": ca.get("session.warm.hits", 0),
+            "warm_hits_b": cb.get("session.warm.hits", 0),
+            "stderr_tail_a": phase_a.stderr.strip()[-300:],
+            "stderr_tail_b": phase_b.stderr.strip()[-300:],
+        }
+    return _finish("session-kill-recover-subprocess", seed, {
+        "phase_a_died_mid_step": phase_a.returncode == 75
+        and terminated(ca) < 7,
+        "phase_b_recovered_cleanly": phase_b.returncode == 0,
+        "invariant_closes_across_kill": admitted == 7
+        and admitted - done == 0,
+        "zero_lost_steps": sorted(final.outcomes) == step_ids
+        and not final.pending,
+        "zero_duplicated_steps": not final.duplicate_outcomes,
+        "mid_step_recovered_not_readmitted":
+            cb.get("serve.recovered", 0) == 1
+            and cb.get("session.recovered", 0) == 1,
+        "stream_closed_at_boundary": srep is not None and srep.closed
+        and srep.last_advanced == 5 and srep.generations == 2,
+    }, detail)
+
+
+@scenario("session-stale-warm-start", group="session")
+def _session_stale_warm_start(seed: int) -> dict:
+    """A geometry JUMP mid-stream (far past the drift bound): the warm
+    validity gate must refuse the previous iterate AUDIBLY and run the
+    step cold — converging fast against the wrong operator is the
+    failure this gate exists to prevent — then warm starts resume once
+    consecutive steps are nearby again. Covers the SessionPolicy warm
+    knobs (drift bound + residual factor) under chaos."""
+    from poisson_tpu.geometry.dsl import Ellipse
+    from poisson_tpu.serve import (
+        ServicePolicy,
+        SessionHost,
+        SessionPolicy,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16,
+            degradation=_quiet_degradation(),
+            session=SessionPolicy(warm_drift_bound=0.05,
+                                  warm_residual_factor=100.0,
+                                  slo_seconds=60.0),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+    )
+    host = SessionHost(svc)
+    p = _problem()
+    sess = host.open("jump", p, geometry=Ellipse())
+    near = [host.step(sess, geometry=Ellipse(cx=5e-4 * k, cy=0.0,
+                                             rx=1.0, ry=1.0))
+            for k in range(3)]
+    hits_before = _counter("session.warm.hits")
+    # The jump: 0.4 of center drift against a 0.05 bound.
+    jumped = host.step(sess, geometry=Ellipse(cx=0.4, cy=0.0,
+                                              rx=0.8, ry=1.0))
+    fallbacks = _counter("session.warm.fallbacks")
+    # Settled again: the next step is nearby, warm starts resume.
+    settled = host.step(sess, geometry=Ellipse(cx=0.4005, cy=0.0,
+                                               rx=0.8, ry=1.0))
+    summary = host.close(sess)
+    return _finish("session-stale-warm-start", seed, {
+        "warm_starts_held_while_nearby": hits_before >= 2
+        and all(o.converged for o in near),
+        "stale_warm_fell_back_audibly": fallbacks == 1
+        and jumped.converged,
+        "cold_fallback_paid_full_iterations":
+            jumped.iterations > max(o.iterations for o in near[1:]),
+        "warm_resumed_after_jump":
+            _counter("session.warm.hits") == hits_before + 1
+            and settled.converged,
+        "stream_closed_good": summary["slo_good"]
+        and summary["errors"] == 0,
+    }, {"iterations": [o.iterations for o in near]
+        + [jumped.iterations, settled.iterations],
+        "fallbacks": fallbacks})
+
+
+@scenario("session-device-loss-reroute", group="session")
+def _session_device_loss_reroute(seed: int) -> dict:
+    """A device dies while a session step is resident on it: the fault
+    domain is marked lost, the step is recovered onto the survivor
+    (retry, typed outcome), and the STREAM continues — later steps
+    dispatch on the surviving device, warm starts intact, the session
+    closing with its one typed outcome. A half-finished stream must
+    survive silicon loss like any request."""
+    from poisson_tpu.geometry.dsl import Ellipse
+    from poisson_tpu.serve import (
+        FleetPolicy,
+        RetryPolicy,
+        ServicePolicy,
+        SessionHost,
+        SolveRequest,
+        SolveService,
+    )
+    from poisson_tpu.testing.faults import device_loss_fault
+
+    vc = VirtualClock()
+    holder: dict = {}
+    svc = SolveService(
+        ServicePolicy(
+            capacity=16,
+            retry=RetryPolicy(max_attempts=3, backoff_base=0.05,
+                              backoff_cap=0.1),
+            degradation=_quiet_degradation(),
+            fleet=FleetPolicy(workers=2, devices=2,
+                              quarantine_seconds=0.02,
+                              recovery_backoff=0.05),
+        ),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        worker_fault=device_loss_fault(
+            {0}, lambda wid: holder["svc"].worker_device(wid)),
+    )
+    holder["svc"] = svc
+    host = SessionHost(svc)
+    p = _problem()
+    sess = host.open("loss", p, geometry=Ellipse())
+    outs = [host.step(sess, geometry=Ellipse(cx=5e-4 * k, cy=0.0,
+                                             rx=1.0, ry=1.0))
+            for k in range(4)]
+    summary = host.close(sess)
+    stats = svc.stats()
+    placement = stats["placement"]
+    return _finish("session-device-loss-reroute", seed, {
+        "device_loss_counted":
+            _counter("serve.fleet.device_losses") == 1,
+        "step_recovered_onto_survivor":
+            _counter("serve.fleet.recovered_requests") >= 1
+            and outs[0].converged and outs[0].attempts == 2,
+        "stream_finished_on_survivor": all(o.converged for o in outs)
+        and set(placement["bindings"].values()) == {1},
+        "warm_starts_survived_reroute":
+            _counter("session.warm.hits") >= 2,
+        "stream_closed_good": summary["errors"] == 0
+        and summary["slo_good"],
+    }, {"attempts": [o.attempts for o in outs],
+        "iterations": [o.iterations for o in outs],
+        "placement": placement})
 
 
 # -- campaign runner ----------------------------------------------------

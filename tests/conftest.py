@@ -130,6 +130,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "session: durable solver-session suite (journal replay to the "
+        "committed step boundary, cold-path HLO pin vs the historical "
+        "solve, stale-warm audible fallback, heat/design stepping, "
+        "one-tree-per-session flight traces, session chaos "
+        "invariants, sentinel cohort pins; CPU-fast; runs in tier-1, "
+        "selectable with -m session)",
+    )
+    config.addinivalue_line(
+        "markers",
         "mg: geometric-multigrid preconditioning suite "
         "(default-jacobi-path HLO/golden pins, two-grid convergence "
         "factor, V-cycle apply bit-parity under vmap, per-family "
