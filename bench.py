@@ -521,6 +521,7 @@ def _serve_geometry_mix_bench(problem, requests: int, mix: int, rate,
     from poisson_tpu.obs import metrics as obs_metrics
     from poisson_tpu.serve import (
         DegradationPolicy,
+        ForecastPolicy,
         RetryPolicy,
         SCHED_CONTINUOUS,
         ServicePolicy,
@@ -539,6 +540,10 @@ def _serve_geometry_mix_bench(problem, requests: int, mix: int, rate,
         degradation=quiet,
         retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
                           backoff_cap=0.1),
+        # Forecaster on in every serve mode: bench requests carry no
+        # deadlines, so admission never sheds — the model just observes,
+        # and the record stamps its p50 calibration error for regress.py.
+        forecast=ForecastPolicy(),
     )
     families = _geometry_families(mix)
     schedule = _poisson_schedule(requests, rate)
@@ -594,6 +599,7 @@ def _serve_geometry_mix_bench(problem, requests: int, mix: int, rate,
             "slowest_requests": _serve_slowest(svc),
             "warmed_buckets": warmed,
             "warmup_seconds": round(warm_seconds, 2),
+            "forecast_calibration_err_pct": _forecast_calibration(svc),
             "dtype": "float32",
             "backend": "xla_serve",
             "devices": 1,
@@ -966,6 +972,7 @@ def _serve_repeat_fp_bench(problem, requests: int, families: int, rate,
     from poisson_tpu.obs.costs import krylov_deflated_cost
     from poisson_tpu.serve import (
         DegradationPolicy,
+        ForecastPolicy,
         RetryPolicy,
         ServicePolicy,
         SolveRequest,
@@ -986,6 +993,7 @@ def _serve_repeat_fp_bench(problem, requests: int, families: int, rate,
         degradation=quiet, krylov=kp,
         retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
                           backoff_cap=0.1),
+        forecast=ForecastPolicy(),
     )
     fams = _geometry_families(families)
     picks = _zipf_families(requests, families)
@@ -1115,6 +1123,7 @@ def _serve_repeat_fp_bench(problem, requests: int, families: int, rate,
             "p99_exemplar": _serve_p99_exemplar(svc),
             "slowest_requests": _serve_slowest(svc),
             "warmup_seconds": round(warm_seconds, 2),
+            "forecast_calibration_err_pct": _forecast_calibration(svc),
             "dtype": "float32",
             "backend": "xla_serve",
             "devices": 1,
@@ -1194,6 +1203,20 @@ def _serve_p99_exemplar(svc):
     return p99_exemplar(svc.outcomes())
 
 
+def _forecast_calibration(svc):
+    """p50 absolute iteration-forecast error (%) the service's
+    forecaster accumulated over this run, or None before any
+    observation. Stamped on every serve record so
+    benchmarks/regress.py can lift it into its own lower-is-better
+    cohort (a forecaster drifting out of calibration silently
+    mis-admits deadlines long before latency moves)."""
+    model = getattr(svc, "_forecast", None)
+    if model is None:
+        return None
+    err = model.calibration_err_pct()
+    return None if err is None else round(err, 2)
+
+
 def _serve_slowest(svc, n: int = 3):
     from poisson_tpu.serve import slowest_requests
 
@@ -1217,6 +1240,7 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
     from poisson_tpu import obs
     from poisson_tpu.serve import (
         DegradationPolicy,
+        ForecastPolicy,
         RetryPolicy,
         SCHED_CONTINUOUS,
         SCHED_DRAIN,
@@ -1241,6 +1265,7 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
             degradation=quiet,
             retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
                               backoff_cap=0.1),
+            forecast=ForecastPolicy(),
         )
 
     def run(mode):
@@ -1303,6 +1328,8 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
             "slowest_requests": _serve_slowest(cont_svc),
             "warmed_buckets": warmed,
             "warmup_seconds": round(warm_seconds, 2),
+            "forecast_calibration_err_pct":
+                _forecast_calibration(cont_svc),
             "dtype": "float32",
             "backend": "xla_serve",
             "devices": 1,
@@ -1357,6 +1384,7 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
     from poisson_tpu.serve import (
         DegradationPolicy,
         FleetPolicy,
+        ForecastPolicy,
         RetryPolicy,
         SCHED_CONTINUOUS,
         ServicePolicy,
@@ -1386,6 +1414,7 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
         fleet=FleetPolicy(workers=workers, quarantine_seconds=0.2,
                           recovery_backoff=0.02,
                           devices=fleet_devices),
+        forecast=ForecastPolicy(),
     )
     schedule = _poisson_schedule(requests, rate)
 
@@ -1487,6 +1516,7 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
             "slowest_requests": _serve_slowest(svc),
             "warmed_buckets": warmed,
             "warmup_seconds": round(warm_seconds, 2),
+            "forecast_calibration_err_pct": _forecast_calibration(svc),
             "dtype": "float32",
             "backend": "xla_serve",
             # The fleet's fault-domain count is experiment identity:
@@ -1543,6 +1573,7 @@ def _serve_bench(problem, requests: int, devices, platform: str,
 
     from poisson_tpu import obs
     from poisson_tpu.serve import (
+        ForecastPolicy,
         RetryPolicy,
         ServicePolicy,
         SolveRequest,
@@ -1556,6 +1587,7 @@ def _serve_bench(problem, requests: int, devices, platform: str,
         capacity=max(requests, 1), max_batch=32,
         retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
                           backoff_cap=0.1),
+        forecast=ForecastPolicy(),
     )
 
     def build():
@@ -1621,6 +1653,7 @@ def _serve_bench(problem, requests: int, devices, platform: str,
             # are not part of the cohort key; pinned by tests).
             "p99_exemplar": _serve_p99_exemplar(svc),
             "slowest_requests": _serve_slowest(svc),
+            "forecast_calibration_err_pct": _forecast_calibration(svc),
             "throughput_rps": round(stats["completed"] / wall, 2),
             "wall_seconds": round(wall, 4),
             "first_run_seconds": round(first_run, 2),

@@ -92,14 +92,19 @@ _FALLBACK_TAIL_MARKS = (
 _METRICS = ("mlups", "batched_solves_per_sec",
             "serve.p99_latency", "serve.shed_rate",
             "serve.sustained_solves_per_sec",
-            "session.steps_per_sec")
+            "session.steps_per_sec",
+            "obs.forecast.calibration_err_pct")
 
 # Service metrics regress UPWARD: a p99 latency or a shed rate that grew
 # is the slowdown, where MLUPS/solves-per-sec regress downward. The
 # alarm line flips sides accordingly (median + guard instead of − guard).
 # serve.sustained_solves_per_sec (the open-loop continuous-batching
 # throughput) is deliberately NOT here: like MLUPS, a drop is the alarm.
-_LOWER_IS_BETTER = {"serve.p99_latency", "serve.shed_rate"}
+# obs.forecast.calibration_err_pct (the p50 absolute iteration-forecast
+# error bench stamps on serve records) also alarms on a RISE: a
+# forecaster drifting out of calibration silently mis-admits deadlines.
+_LOWER_IS_BETTER = {"serve.p99_latency", "serve.shed_rate",
+                    "obs.forecast.calibration_err_pct"}
 
 
 def _mk_record(source: str, *, value=None, metric=None, platform=None,
@@ -229,6 +234,30 @@ def record_from_result(result: dict, source: str,
     )
 
 
+def records_from_result(result: dict, source: str,
+                        fallback_hint: bool = False) -> list[dict]:
+    """:func:`record_from_result` plus the calibration lift: a serve-
+    mode bench record stamping ``detail["forecast_calibration_err_pct"]``
+    (bench.py records it on every --serve run) yields a SECOND record
+    under the ``obs.forecast.calibration_err_pct`` metric — the same
+    experiment identity, its own metric cohort (metric is part of
+    :func:`cohort_key`), with the lower-is-better direction pin: a
+    forecaster whose p50 iteration error grew is the regression."""
+    rec = record_from_result(result, source, fallback_hint)
+    if rec is None:
+        return []
+    out = [rec]
+    det = result.get("detail") or {}
+    cal = det.get("forecast_calibration_err_pct")
+    if cal is not None:
+        lifted = dict(rec)
+        lifted["source"] = f"{source}:forecast-calibration"
+        lifted["metric"] = "obs.forecast.calibration_err_pct"
+        lifted["value"] = cal
+        out.append(lifted)
+    return out
+
+
 def load_driver_artifact(path) -> list[dict]:
     """One BENCH_rNN.json driver snapshot ({n, cmd, rc, tail, parsed}).
     A nonzero rc or an unparseable bench line is a failed-run record —
@@ -249,8 +278,7 @@ def load_driver_artifact(path) -> list[dict]:
             path.name, failed=True,
             note=f"rc={raw.get('rc')}, no parsed bench record",
         )]
-    rec = record_from_result(parsed, path.name, fallback_hint)
-    return [rec] if rec else []
+    return records_from_result(parsed, path.name, fallback_hint)
 
 
 def load_good_artifact(path) -> list[dict]:
@@ -274,12 +302,9 @@ def load_good_artifact(path) -> list[dict]:
             if stamp in seen:
                 continue
             seen.add(stamp)
-            rec = record_from_result(entry, f"{path.name}:{slot}")
-            if rec:
-                out.append(rec)
+            out.extend(records_from_result(entry, f"{path.name}:{slot}"))
         return out
-    rec = record_from_result(raw, path.name)
-    return [rec] if rec else []
+    return records_from_result(raw, path.name)
 
 
 def load_session(path) -> list[dict]:
@@ -302,12 +327,10 @@ def load_session(path) -> list[dict]:
             continue
         if not isinstance(entry, dict):
             continue
-        rec = record_from_result(
+        out.extend(records_from_result(
             entry.get("result"),
             f"{path.name}:{i + 1} ({entry.get('step', '?')})",
-        )
-        if rec:
-            out.append(rec)
+        ))
     return out
 
 

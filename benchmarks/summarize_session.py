@@ -458,6 +458,54 @@ def telemetry_report(tdir: pathlib.Path) -> int:
                   f"{e.get('iterations_saved')} iteration(s) saved) — "
                   f"{verdict}")
 
+    # Forecasting (obs.forecast): the convergence observatory's feedback
+    # loop — predictions made, cold-vs-calibrated split, the p50
+    # absolute iteration error, predicted-deadline sheds (admission and
+    # re-forecast preemption), and snapshot persistence activity.
+    forecast_counters = {
+        name: val for name, val in counters.items()
+        if name.startswith(("obs.forecast.", "serve.forecast."))
+        or name in ("serve.shed.predicted_deadline",
+                    "serve.degraded.backlog_driven")}
+    forecast_gauges: dict = {}
+    for _rank in sorted(gauges_by_rank):
+        for name, val in (gauges_by_rank[_rank] or {}).items():
+            # calibration_pct is a histogram (a dict of buckets) — the
+            # scalar gauges are the readable summary; skip non-numerics.
+            if (name.startswith(("obs.forecast.", "serve.forecast."))
+                    and isinstance(val, (int, float))):
+                forecast_gauges.setdefault(name, val)
+    if forecast_counters or forecast_gauges:
+        print("\n## Forecasting\n")
+        merged = dict(forecast_counters)
+        merged.update(forecast_gauges)
+        print("| forecast metric | value |")
+        print("|---|---|")
+        for name in sorted(merged):
+            val = merged[name]
+            shown = (f"{val:.4f}" if isinstance(val, float)
+                     and val != int(val) else str(int(val)))
+            print(f"| {name} | {shown} |")
+        preds = forecast_counters.get("obs.forecast.predictions", 0)
+        cold = forecast_counters.get("obs.forecast.cold_cohorts", 0)
+        calib = forecast_gauges.get("obs.forecast.calibration_err_pct")
+        shed = forecast_counters.get("serve.shed.predicted_deadline", 0)
+        preempt = forecast_counters.get("serve.forecast.preempted", 0)
+        calib_txt = (f"p50 absolute iteration error {calib:.1f}%"
+                     if calib is not None
+                     else "no calibration figure yet (no completed "
+                          "observations)")
+        print(f"\n{int(preds)} prediction(s), {int(cold)} cold-seeded "
+              f"cohort(s); {calib_txt}. "
+              f"{int(shed)} request(s) shed as predicted-deadline "
+              f"(typed, zero compute burned), {int(preempt)} of those "
+              f"preempted mid-flight by a lane-boundary re-forecast; "
+              f"{int(forecast_counters.get('obs.forecast.snapshot.saves', 0))} "
+              f"snapshot save(s), "
+              f"{int(forecast_counters.get('obs.forecast.snapshot.torn', 0))} "
+              f"torn-snapshot event(s) (each audible, model falls back "
+              f"to cold seeds).")
+
     # Flight recorder (obs.flight): per-request causal traces and their
     # latency decompositions — render the aggregate view plus ONE
     # request's end-to-end timeline (the slowest, the request a p99

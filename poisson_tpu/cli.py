@@ -1060,6 +1060,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--refill-chunk", type=int, default=25,
                    help="iterations per lane-table step in --continuous "
                         "mode (default 25)")
+    p.add_argument("--forecast", action="store_true",
+                   help="convergence observatory "
+                        "(ServicePolicy.forecast): ETA every admission "
+                        "from the per-cohort streaming model, shed "
+                        "predicted-dead deadlines at submit (typed "
+                        "predicted_deadline, zero compute burned), "
+                        "re-forecast lane occupants at chunk "
+                        "boundaries, and feed every completion back "
+                        "into calibration; with --journal the model "
+                        "snapshot persists beside it and --recover "
+                        "warm-loads it")
     p.add_argument("--workers", type=int, default=1, metavar="W",
                    help="solve-fleet workers pulling from the shared "
                         "admission queue (serve.fleet; default 1 — the "
@@ -1165,6 +1176,7 @@ def _main_serve(argv) -> int:
         SCHED_CONTINUOUS,
         SCHED_DRAIN,
         FleetPolicy,
+        ForecastPolicy,
         ServicePolicy,
         SolveJournal,
         SolveRequest,
@@ -1207,6 +1219,7 @@ def _main_serve(argv) -> int:
         integrity=IntegrityPolicy(verify_every=args.verify_every,
                                   verify_tol=args.verify_tol),
         preconditioner=args.preconditioner,
+        forecast=(ForecastPolicy() if args.forecast else None),
     )
     journal = (SolveJournal(args.journal) if args.journal else None)
     if args.recover:
@@ -1286,6 +1299,17 @@ def _main_serve(argv) -> int:
             "suspect_cohorts": _metrics.get(
                 "serve.integrity.suspect_cohorts"),
             "errors": _metrics.get("serve.errors.integrity"),
+        }
+    if args.forecast:
+        calib = (svc._forecast.calibration_err_pct()
+                 if svc._forecast is not None else None)
+        record["forecast"] = {
+            "predictions": _metrics.get("obs.forecast.predictions"),
+            "predicted_deadline_sheds": _metrics.get(
+                "serve.shed.predicted_deadline"),
+            "preempted": _metrics.get("serve.forecast.preempted"),
+            "calibration_err_pct": (round(calib, 2)
+                                    if calib is not None else None),
         }
     if args.workers > 1 or args.kill_worker_at is not None:
         record["fleet"] = {
@@ -1393,6 +1417,90 @@ def _main_trace(argv) -> int:
               file=sys.stderr)
         return 1
     return 0
+
+
+def build_top_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m poisson_tpu top",
+        description="One-screen fleet scoreboard: queue depth and "
+                    "predicted ETA backlog, active lanes, breaker "
+                    "states, SLO burn, cache hit rates, placement "
+                    "epoch, and forecast calibration — rendered from "
+                    "a live Prometheus endpoint, a textfile export, "
+                    "or a telemetry snapshot directory (the last one "
+                    "works on a dead process's artifacts).",
+    )
+    p.add_argument("--endpoint", metavar="URL",
+                   help="live Prometheus endpoint "
+                        "(obs.export.start_http_server), e.g. "
+                        "http://127.0.0.1:9464/metrics")
+    p.add_argument("--textfile", metavar="PATH",
+                   help="Prometheus textfile (POISSON_TPU_PROM / "
+                        "obs.export.write_textfile)")
+    p.add_argument("--metrics-dir", metavar="DIR",
+                   help="telemetry directory with metrics-*.json "
+                        "snapshots (obs.metrics.write_snapshot) — "
+                        "post-mortem scoreboard for a dead process")
+    p.add_argument("--watch", type=float, default=0.0, metavar="N",
+                   help="re-render every N seconds until interrupted "
+                        "(default: render once)")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON object per render instead of the "
+                        "screen (automation / tests)")
+    return p
+
+
+def _main_top(argv) -> int:
+    args = build_top_parser().parse_args(argv)
+    sources = [s for s in (args.endpoint, args.textfile,
+                           args.metrics_dir) if s]
+    if len(sources) != 1:
+        print("top needs exactly one of --endpoint / --textfile / "
+              "--metrics-dir", file=sys.stderr)
+        return 2
+    # Scoreboard rendering is pure stdlib over the metrics registry
+    # shapes — no jax import, so `top` works on a box that only has
+    # the artifacts.
+    from poisson_tpu.obs import forecast as _forecast
+
+    def read_metrics() -> dict:
+        if args.endpoint:
+            import urllib.request
+
+            with urllib.request.urlopen(args.endpoint, timeout=5) as r:
+                text = r.read().decode("utf-8", "replace")
+            from poisson_tpu.obs import export
+
+            return export.parse_text(text)
+        if args.textfile:
+            from poisson_tpu.obs import export
+
+            with open(args.textfile, encoding="utf-8") as f:
+                return export.parse_text(f.read())
+        from poisson_tpu.obs import metrics
+
+        return metrics.load_dir(args.metrics_dir)
+
+    try:
+        while True:
+            try:
+                board = _forecast.build_scoreboard(read_metrics())
+            except (OSError, ValueError) as e:
+                print(f"scoreboard source unreadable: {e}",
+                      file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(board, sort_keys=True), flush=True)
+            else:
+                if args.watch:
+                    # Home + clear-to-end: repaint in place like top(1).
+                    sys.stdout.write("\x1b[H\x1b[J")
+                print(_forecast.render_scoreboard(board), flush=True)
+            if not args.watch:
+                return 0
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0
 
 
 def build_geometry_parser() -> argparse.ArgumentParser:
@@ -1774,6 +1882,8 @@ def main(argv=None) -> int:
         return _main_chaos(argv[1:])
     if argv and argv[0] == "trace":
         return _main_trace(argv[1:])
+    if argv and argv[0] == "top":
+        return _main_top(argv[1:])
     if argv and argv[0] == "geometry":
         return _main_geometry(argv[1:])
     args = build_parser().parse_args(argv)

@@ -45,15 +45,33 @@ MG_MARKERS = ("dot_general", "dot-general")
 _METADATA_RE = re.compile(r", metadata=\{[^}]*\}")
 _LOC_INLINE_RE = re.compile(r"\s*loc\([^()]*(?:\([^()]*\)[^()]*)*\)")
 _LOC_LINE_RE = re.compile(r"^#loc.*$", re.MULTILINE)
+# A host callback's backend_config is the host-side callable's ADDRESS
+# (``xla_python_cpu_callback`` carries the pointer as a decimal string)
+# — process-lifetime identity, not program structure. Left in place it
+# makes every callback-bearing program's fingerprint unstable across
+# processes, which would turn the ledger gate into noise for exactly
+# the opt-in programs (stream/verify/history ON) it should also cover.
+# Only all-digit configs are normalized: real kernel configs (proto or
+# JSON blobs) never look like a bare pointer. The same pointer value
+# also rides into the program as an i64 ``stablehlo.constant`` operand
+# of the custom_call — exactly those constants (value-matched against
+# the collected backend_config pointers) are normalized with it.
+_CALLBACK_PTR_RE = re.compile(r'backend_config = "(\d+)"')
 
 
 def strip_hlo_metadata(text: str) -> str:
     """Canonicalize program text: drop ``metadata={...}`` annotations
     (compiled HLO), inline ``loc(...)`` attributes and ``#loc`` lines
-    (StableHLO). The historical test-pin strip, now in one place."""
+    (StableHLO), and normalize host-callback pointer identities. The
+    historical test-pin strip, now in one place."""
     text = _METADATA_RE.sub("", text)
     text = _LOC_INLINE_RE.sub("", text)
     text = _LOC_LINE_RE.sub("", text)
+    ptrs = set(_CALLBACK_PTR_RE.findall(text))
+    text = _CALLBACK_PTR_RE.sub('backend_config = "<host-callback>"',
+                                text)
+    for ptr in ptrs:
+        text = text.replace(f"dense<{ptr}>", "dense<HOST_CALLBACK_PTR>")
     return text
 
 

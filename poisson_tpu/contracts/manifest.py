@@ -84,7 +84,7 @@ def _build_solve_jacobi_f64() -> str:
     from poisson_tpu.solvers.pcg import _solve
 
     a, b, rhs, aux = _setup("float64", False)
-    return _solve.lower(_problem(), False, 0, 0, 0.0, False,
+    return _solve.lower(_problem(), False, 0, 0, 0.0, False, 0,
                         a, b, rhs, aux).as_text()
 
 
@@ -92,7 +92,19 @@ def _build_solve_scaled_f32() -> str:
     from poisson_tpu.solvers.pcg import _solve
 
     a, b, rhs, aux = _setup("float32", True)
-    return _solve.lower(_problem(), True, 0, 0, 0.0, False,
+    return _solve.lower(_problem(), True, 0, 0, 0.0, False, 0,
+                        a, b, rhs, aux).as_text()
+
+
+def _build_solve_history_f64() -> str:
+    """The history-ON solve (``history_every=5``) — the forecast seam's
+    opt-in program. Pinned so the convergence-history callback's shape
+    is itself a contract: drift here means the traced telemetry
+    changed, not just the flag-off byte-pin."""
+    from poisson_tpu.solvers.pcg import _solve
+
+    a, b, rhs, aux = _setup("float64", False)
+    return _solve.lower(_problem(), False, 0, 0, 0.0, False, 5,
                         a, b, rhs, aux).as_text()
 
 
@@ -198,7 +210,7 @@ def _build_session_step_cold() -> str:
     from poisson_tpu.solvers.pcg import _solve
 
     a, b, rhs, aux = _setup("float64", False)
-    return _solve.lower(_problem(), False, 0, 0, 0.0, False,
+    return _solve.lower(_problem(), False, 0, 0, 0.0, False, 0,
                         a, b, rhs, aux).as_text()
 
 
@@ -257,6 +269,14 @@ PROGRAMS: Tuple[ProgramSpec, ...] = (
                     "precision policy), all flags off",
         forbid=_ALL_OFF,
         build=_build_solve_scaled_f32,
+    ),
+    ProgramSpec(
+        name="solve.history_f64",
+        description="pcg_solve with history_every=5 — the forecast "
+                    "residual-history seam's opt-in program (callbacks "
+                    "legal here; collectives/mg still forbidden)",
+        forbid=("collectives", "mg"),
+        build=_build_solve_history_f64,
     ),
     ProgramSpec(
         name="batched.mesh_none_f64",
@@ -489,6 +509,11 @@ ATTRIBUTION_ONLY_DETAIL = {
     "makespan_seconds": "raw timing payload",
     "p50_seconds": "latency payload (p99 is the record's own metric)",
     "p99_seconds": "latency payload",
+    "forecast_calibration_err_pct":
+        "measured forecaster error, not identity — records_from_result "
+        "lifts it into its own obs.forecast.calibration_err_pct "
+        "sentinel record (lower-is-better), it never splits the "
+        "primary record's cohort",
     "verify_overhead": "the A/B delta is the record's payload",
     "preconditioner_ab": "both-arm A/B payload (cohort key carries "
                          "detail.preconditioner)",

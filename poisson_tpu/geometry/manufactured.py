@@ -312,7 +312,7 @@ def manufactured_error(case: ManufacturedCase, M: int, N: int,
                            jnp.asarray(rhs_use, dt),
                            jnp.asarray(aux64, dt), hier)
     else:
-        result = _solve(problem, use_scaled, 0, 0, 0.0, False,
+        result = _solve(problem, use_scaled, 0, 0, 0.0, False, 0,
                         jnp.asarray(a64, dt), jnp.asarray(b64, dt),
                         jnp.asarray(rhs_use, dt), jnp.asarray(aux64, dt))
 

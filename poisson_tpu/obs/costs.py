@@ -410,7 +410,7 @@ def solve_program_costs(problem, dtype=None, scaled=None,
     use_scaled = resolve_scaled(scaled, dtype_name)
     a, b, rhs, aux = host_setup(problem, dtype_name, use_scaled)
     compiled = _solve.lower(problem, use_scaled, int(stream_every),
-                            0, 0.0, False, a, b, rhs, aux).compile()
+                            0, 0.0, False, 0, a, b, rhs, aux).compile()
     cost = program_costs(compiled)
     mem = program_memory(compiled)
     report = {

@@ -84,6 +84,10 @@ POINT_SESSION_STEP = "session_step"     # one step of a durable session
 #                                    advanced (serve.session)
 POINT_WARM_FALLBACK = "warm_fallback"   # an offered warm start failed the
 #                                    validity gate — the step ran cold
+POINT_FORECAST_SHED = "forecast_shed"   # refused at admission: the p90 ETA
+#                                    said the deadline cannot be met
+POINT_REFORECAST = "reforecast"         # lane-boundary re-forecast verdict:
+#                                    measured slope says hopeless — pre-empt
 
 _ROOT_SPAN_ID = 0
 
@@ -215,6 +219,18 @@ class FlightRecorder:
         obs.event("flight.point", trace_id=tr.trace_id,
                   request_id=str(request_id), point=name,
                   t=self._clock(), **attrs)
+
+    def annotate(self, request_id, span: str, **attrs) -> None:
+        """Merge attrs into an OPEN span's begin-attrs so they ride the
+        ``flight.span`` event when it eventually closes — progress
+        context discovered mid-span (iterations/chunk, ETA fractions)
+        without emitting an extra record per boundary. Later values
+        win; a no-op for unknown requests or closed spans."""
+        tr = self._traces.get(request_id)
+        if tr is None or span not in tr.open_spans:
+            return
+        span_id, t0, begin_attrs = tr.open_spans[span]
+        begin_attrs.update(attrs)
 
     def add_step(self, request_id, seconds: float, iterations: int,
                  compute_share: float, dispatch_id: str,
@@ -626,7 +642,8 @@ def render_timeline(records: List[dict]) -> str:
         elif name == "flight.span":
             extra = []
             for key in ("bucket", "lane", "dispatch", "mode", "batch",
-                        "worker", "error", "iterations", "flag"):
+                        "worker", "error", "iterations", "flag",
+                        "dk", "k", "progress", "eta"):
                 val = _field(rec, key)
                 if val is not None:
                     extra.append(f"{key}={val}")
@@ -638,7 +655,7 @@ def render_timeline(records: List[dict]) -> str:
             extra = []
             for key in ("dispatch_id", "k", "dk", "attempt", "error",
                         "lane", "compute_share", "worker", "reason",
-                        "generation"):
+                        "generation", "eta", "deadline", "remaining"):
                 val = _field(rec, key)
                 if val is not None:
                     extra.append(f"{key}={val}")

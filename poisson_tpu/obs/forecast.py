@@ -1,0 +1,627 @@
+"""Convergence observatory: online iteration forecasting, mid-flight
+rate estimation, and the fleet scoreboard.
+
+Every observability layer before this one is retrospective — the flight
+recorder decomposes latency *after* the outcome, the sentinel judges
+runs *after* the bench. But PCG iteration counts are highly predictable
+per cohort (golden counts are bit-stable; repeat traffic is keyed by
+geometry fingerprint), so the telemetry the stack already emits can be
+turned into *foresight*. Three cooperating pieces live here:
+
+1. :class:`ForecastModel` — a per-cohort streaming estimator of
+   iteration count (median/p90) and measured per-iteration wall
+   (sourced from the flight recorder's compute decomposition). Cold
+   cohorts are seeded from the analytic ``obs/costs.py`` model:
+   iterations ≈ √(M·N) (the classical CG ~√κ ~ √(grid) bound) and
+   per-iteration seconds = analytic bytes / platform peak bandwidth.
+   The model persists as a CRC-sealed JSON snapshot beside the journal
+   (same ``zlib.crc32`` sealing idiom as ``serve.journal``) and is
+   warm-loadable on recovery; torn snapshots are skipped audibly
+   (``obs.forecast.snapshot.torn``), never fatal.
+
+2. The ``history_every`` residual-history seam — an opt-in ring buffer
+   of (k, ‖Δw‖) samples traced into the fused loop exactly like
+   ``stream_every``/``verify_every``: a ``lax.cond`` +
+   ``jax.debug.callback`` planted only when the STATIC flag is > 0, so
+   flag-off programs stay byte-identical (pinned by the contracts
+   ledger). The host-side estimator (:func:`log_residual_slope`,
+   :func:`remaining_iterations`) turns the samples into an asymptotic
+   convergence rate and a remaining-iterations ETA.
+
+3. :func:`build_scoreboard` — the one-screen operator surface behind
+   ``python -m poisson_tpu top``, reducing a metrics registry (live
+   snapshot, Prometheus textfile/endpoint parse, or a dead process's
+   ``metrics-rank*.json`` dir) to queue/backlog, lanes, breakers, SLO
+   burn, cache hit rates, placement epoch, and forecast calibration.
+
+Counter feedback per completed solve: ``obs.forecast.predictions``
+(one per predict-then-compare), ``obs.forecast.abs_err_pct`` (last
+absolute iteration error), ``obs.forecast.cold_cohorts`` (prediction
+served from the analytic seed), the ``obs.forecast.calibration_pct``
+histogram, and ``obs.forecast.calibration_err_pct`` (running p50
+absolute error — the sentinel-lifted calibration figure).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import zlib
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from poisson_tpu.obs import metrics as obs
+
+# Cold-model fallback bandwidth (GB/s) when the device kind is unknown
+# to ``obs.costs.platform_peak_gbps`` — deliberately pessimistic (a
+# modest host) so cold ETAs over-estimate rather than under-admit.
+DEFAULT_COLD_GBPS = 10.0
+
+# Per-cohort sample windows: enough history to ride out noise, small
+# enough that a drifting cohort (new compiler, new device) re-learns
+# within ~a bench run.
+SAMPLE_WINDOW = 128
+
+# Calibration histogram bucket upper bounds, in ABSOLUTE PERCENT error
+# (|predicted − actual| / actual × 100). Exported as the
+# ``obs.forecast.calibration_pct`` histogram gauge.
+CALIBRATION_BUCKETS_PCT = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0,
+                           200.0)
+
+# Cold p90 head-room multiplier over the √(M·N) median seed: the
+# analytic model has no spread, so the admission guard gets a margin.
+COLD_P90_FACTOR = 1.5
+
+SNAPSHOT_VERSION = 1
+
+
+# -- residual-history seam (the history_every solver flag) ---------------
+
+class HistoryBuffer:
+    """Host-side ring of streamed (k, ‖Δw‖) samples — the receiver for
+    :func:`history_tap`. One buffer per in-flight estimation window;
+    the service keeps per-request rings of lane-boundary samples
+    instead (``lane_view`` already surfaces per-member diffs), so this
+    sink is for single-solve drivers (``pcg_solve(history_every=K)``)."""
+
+    def __init__(self, maxlen: int = 256):
+        self.samples: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def emit(self, k: int, diff: float) -> None:
+        with self._lock:
+            self.samples.append((int(k), float(diff)))
+
+    def slope(self) -> Optional[float]:
+        with self._lock:
+            return log_residual_slope(list(self.samples))
+
+
+_LOCK = threading.Lock()
+_HISTORY: Optional[HistoryBuffer] = None
+
+
+def set_history(buf: Optional[HistoryBuffer]) -> Optional[HistoryBuffer]:
+    """Install the process-wide history sink; returns the previous one."""
+    global _HISTORY
+    with _LOCK:
+        prev, _HISTORY = _HISTORY, buf
+    return prev
+
+
+def get_history() -> Optional[HistoryBuffer]:
+    return _HISTORY
+
+
+def history_tap(k, diff) -> None:
+    """The ``jax.debug.callback`` target — stable module-level identity
+    (part of the traced program), dynamic dispatch to the active
+    buffer. With no buffer the sample drops: a compiled history-on
+    program stays valid across runs that do not record."""
+    buf = _HISTORY
+    if buf is not None:
+        try:
+            buf.emit(int(k), float(diff))
+        except Exception:
+            pass    # telemetry must never take the solve down
+
+
+def emit_history(history_every: int, k, diff) -> None:
+    """Plant the history tap in a traced loop body: every
+    ``history_every``-th iteration ships (k, ‖Δw‖) to
+    :func:`history_tap`. Call only with ``history_every > 0`` — the
+    caller's STATIC flag is what keeps non-history programs
+    byte-identical (same contract as ``obs.stream.emit_every``)."""
+    import jax
+    from jax import lax
+
+    lax.cond(
+        (k % history_every) == 0,
+        lambda: jax.debug.callback(history_tap, k, diff),
+        lambda: None,
+    )
+
+
+# -- rate estimation -----------------------------------------------------
+
+def log_residual_slope(
+        samples: Sequence[Tuple[int, float]]) -> Optional[float]:
+    """Least-squares slope of ln‖Δw‖ against k. PCG converges
+    asymptotically linearly (rate bounded by (√κ−1)/(√κ+1)), so the
+    log-residual is asymptotically a line; its slope is the per-
+    iteration log-reduction. Returns None when fewer than two positive
+    samples exist or k has no spread (slope undefined, not zero)."""
+    pts = [(float(k), math.log(d)) for k, d in samples if d > 0.0]
+    if len(pts) < 2:
+        return None
+    n = float(len(pts))
+    sx = sum(k for k, _ in pts)
+    sy = sum(y for _, y in pts)
+    sxx = sum(k * k for k, _ in pts)
+    sxy = sum(k * y for k, y in pts)
+    denom = n * sxx - sx * sx
+    if denom <= 0.0:
+        return None
+    return (n * sxy - sx * sy) / denom
+
+
+def remaining_iterations(diff: float, delta: float,
+                         slope: Optional[float]) -> Optional[int]:
+    """Iterations left until ‖Δw‖ ≤ delta at the estimated slope.
+    None when the estimate cannot be made (no slope, stagnating or
+    diverging slope, non-positive inputs) — callers must treat None as
+    "unknown", never as "done"."""
+    if slope is None or slope >= 0.0 or diff <= 0.0 or delta <= 0.0:
+        return None
+    if diff <= delta:
+        return 0
+    return int(math.ceil(math.log(delta / diff) / slope))
+
+
+def progress_fraction(done: int, predicted_total: int) -> float:
+    """done/predicted, clamped to [0, 1] — the scoreboard/flight-span
+    progress figure. A prediction can under-shoot, hence the clamp."""
+    if predicted_total <= 0:
+        return 0.0
+    return max(0.0, min(1.0, float(done) / float(predicted_total)))
+
+
+# -- the cold (analytic) model -------------------------------------------
+
+def cold_iterations(M: int, N: int) -> int:
+    """Analytic iteration seed: CG on the 5-point Laplacian needs
+    O(√κ) ~ O(√(M·N)) iterations. Within ~25% of the published golden
+    counts (40×40→50, 800×1200→989, 1600×2400→1858) — good enough to
+    bootstrap admission until the cohort warms."""
+    return max(1, int(round(math.sqrt(float(M) * float(N)))))
+
+
+def cold_seconds_per_iteration(M: int, N: int, *, dtype_bytes: int = 8,
+                               scaled: bool = True,
+                               device_kind: Optional[str] = None) -> float:
+    """Analytic per-iteration wall: the cost model's bytes-per-
+    iteration over the platform's peak memory bandwidth (the solve is
+    bandwidth-bound — SURVEY §5). Unknown platforms fall back to
+    :data:`DEFAULT_COLD_GBPS`, pessimistic on purpose."""
+    from poisson_tpu.obs.costs import analytic_iteration_cost, \
+        platform_peak_gbps
+
+    cost = analytic_iteration_cost(M, N, dtype_bytes=dtype_bytes,
+                                   scaled=scaled)
+    gbps = platform_peak_gbps(device_kind)
+    if gbps is None or gbps <= 0.0:
+        gbps = DEFAULT_COLD_GBPS
+    return float(cost["bytes"]) / (gbps * 1e9)
+
+
+# -- the online per-cohort model -----------------------------------------
+
+@dataclass(frozen=True)
+class Forecast:
+    """One admission-time prediction. ``eta_*_seconds`` are iterations
+    × per-iteration wall; ``cold`` marks an analytic (unwarmed) seed;
+    ``samples`` is how many completed solves back the numbers."""
+
+    cohort: str
+    iterations_p50: float
+    iterations_p90: float
+    seconds_per_iteration: float
+    eta_p50_seconds: float
+    eta_p90_seconds: float
+    cold: bool
+    samples: int
+
+
+def _quantile(ordered: List[float], q: float) -> float:
+    """Nearest-rank quantile of an already-sorted list."""
+    if not ordered:
+        return 0.0
+    idx = min(len(ordered) - 1, max(0, int(math.ceil(q * len(ordered))) - 1))
+    return ordered[idx]
+
+
+class _CohortStats:
+    __slots__ = ("iterations", "spi")
+
+    def __init__(self):
+        self.iterations: deque = deque(maxlen=SAMPLE_WINDOW)
+        self.spi: deque = deque(maxlen=SAMPLE_WINDOW)
+
+
+def cohort_name(*parts) -> str:
+    """Canonical cohort key: the serving dimensions joined with '|'
+    (grid, dtype, scaled, preconditioner, geometry family, krylov
+    mode, backend, device kind). None renders as '-' so keys are
+    stable across processes and JSON round-trips."""
+    return "|".join("-" if p is None else str(p) for p in parts)
+
+
+def _seal(payload: dict) -> int:
+    """CRC32 over the canonical (sorted-key) JSON — the same sealing
+    idiom as ``serve.journal`` so a torn snapshot is detected, not
+    trusted."""
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return zlib.crc32(blob.encode()) & 0xFFFFFFFF
+
+
+def snapshot_path(journal_path: str) -> str:
+    """The forecast snapshot lives beside the journal it serves."""
+    return journal_path + ".forecast.json"
+
+
+class ForecastModel:
+    """Per-cohort streaming iteration/wall estimator.
+
+    :meth:`predict` is PURE (no counters) — the admission guard and
+    the feedback path both call it. :meth:`observe` is the feedback
+    edge: predict-then-compare on the just-completed solve, publish
+    the calibration counters, then absorb the sample (insertion after
+    comparison, so the model never grades itself on a sample it
+    already contains)."""
+
+    def __init__(self):
+        self._cohorts: Dict[str, _CohortStats] = {}
+        self._errs: deque = deque(maxlen=SAMPLE_WINDOW * 4)
+        from poisson_tpu.obs.flight import LatencyHistogram
+        self._calibration = LatencyHistogram(CALIBRATION_BUCKETS_PCT)
+        self._lock = threading.Lock()
+
+    # -- prediction ------------------------------------------------------
+
+    def predict(self, cohort: str, *, M: int, N: int,
+                dtype_bytes: int = 8, scaled: bool = True,
+                device_kind: Optional[str] = None) -> Forecast:
+        cold_spi = cold_seconds_per_iteration(
+            M, N, dtype_bytes=dtype_bytes, scaled=scaled,
+            device_kind=device_kind)
+        with self._lock:
+            stats = self._cohorts.get(cohort)
+            iters = sorted(stats.iterations) if stats else []
+            spis = sorted(s for s in (stats.spi if stats else []) if s > 0.0)
+        if iters:
+            it50 = _quantile(iters, 0.5)
+            it90 = _quantile(iters, 0.9)
+            cold = False
+        else:
+            it50 = float(cold_iterations(M, N))
+            it90 = it50 * COLD_P90_FACTOR
+            cold = True
+        # Measured per-iteration wall when the cohort has any positive
+        # samples; the analytic figure otherwise. Deterministic clocks
+        # (chaos campaigns run on VirtualClock, where steps take zero
+        # measured time) therefore always fall back to the analytic
+        # model — which is what makes predicted-deadline drills
+        # reproducible.
+        spi = _quantile(spis, 0.5) if spis else cold_spi
+        return Forecast(cohort=cohort, iterations_p50=it50,
+                        iterations_p90=it90, seconds_per_iteration=spi,
+                        eta_p50_seconds=it50 * spi,
+                        eta_p90_seconds=it90 * spi,
+                        cold=cold, samples=len(iters))
+
+    # -- feedback --------------------------------------------------------
+
+    def observe(self, cohort: str, iterations: int,
+                compute_seconds: float, *, M: int, N: int,
+                dtype_bytes: int = 8, scaled: bool = True,
+                device_kind: Optional[str] = None) -> float:
+        """Feed back one completed solve; returns the absolute percent
+        iteration error of the pre-insertion prediction."""
+        fc = self.predict(cohort, M=M, N=N, dtype_bytes=dtype_bytes,
+                          scaled=scaled, device_kind=device_kind)
+        actual = max(1, int(iterations))
+        err_pct = abs(fc.iterations_p50 - actual) / float(actual) * 100.0
+        obs.inc("obs.forecast.predictions")
+        if fc.cold:
+            obs.inc("obs.forecast.cold_cohorts")
+        obs.gauge("obs.forecast.abs_err_pct", round(err_pct, 3))
+        with self._lock:
+            self._calibration.observe(err_pct)
+            self._errs.append(err_pct)
+            p50_err = _quantile(sorted(self._errs), 0.5)
+            obs.gauge("obs.forecast.calibration_pct",
+                      self._calibration.snapshot())
+            obs.gauge("obs.forecast.calibration_err_pct",
+                      round(p50_err, 3))
+            stats = self._cohorts.setdefault(cohort, _CohortStats())
+            stats.iterations.append(int(iterations))
+            if compute_seconds > 0.0 and iterations > 0:
+                stats.spi.append(float(compute_seconds) / float(iterations))
+        return err_pct
+
+    def calibration_err_pct(self) -> Optional[float]:
+        """Running p50 absolute iteration error (percent) across every
+        observation, or None before the first feedback."""
+        with self._lock:
+            if not self._errs:
+                return None
+            return _quantile(sorted(self._errs), 0.5)
+
+    def cohorts(self) -> Dict[str, dict]:
+        """A read-only view for the scoreboard/summaries: per-cohort
+        sample counts and medians."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for key, stats in self._cohorts.items():
+                iters = sorted(stats.iterations)
+                spis = sorted(s for s in stats.spi if s > 0.0)
+                out[key] = {
+                    "samples": len(iters),
+                    "iterations_p50": _quantile(iters, 0.5),
+                    "iterations_p90": _quantile(iters, 0.9),
+                    "seconds_per_iteration":
+                        _quantile(spis, 0.5) if spis else None,
+                }
+        return out
+
+    # -- persistence -----------------------------------------------------
+
+    def save(self, path: str) -> bool:
+        """Atomically write the CRC-sealed snapshot (tmp + rename, the
+        checkpoint idiom). Best-effort: a failing snapshot disk must
+        not take the service down."""
+        with self._lock:
+            payload = {
+                "version": SNAPSHOT_VERSION,
+                "cohorts": {
+                    key: {"iterations": list(stats.iterations),
+                          "spi": [round(s, 12) for s in stats.spi]}
+                    for key, stats in self._cohorts.items()
+                },
+                "errs": [round(e, 6) for e in self._errs],
+            }
+        payload["crc32"] = _seal(payload)
+        tmp = path + ".tmp"
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, sort_keys=True)
+            os.replace(tmp, path)
+        except (OSError, ValueError):
+            obs.inc("obs.forecast.snapshot.write_errors")
+            return False
+        obs.inc("obs.forecast.snapshot.saves")
+        return True
+
+    def load(self, path: str) -> bool:
+        """Warm-load a snapshot in place. Missing files are silent
+        (cold start is normal); torn/tampered files are skipped
+        AUDIBLY (``obs.forecast.snapshot.torn``) and leave the model
+        cold — a corrupt forecast must never poison admission."""
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+        except FileNotFoundError:
+            return False
+        except (OSError, ValueError):
+            obs.inc("obs.forecast.snapshot.torn")
+            return False
+        if not isinstance(payload, dict):
+            obs.inc("obs.forecast.snapshot.torn")
+            return False
+        stored = payload.pop("crc32", None)
+        if stored is None or _seal(payload) != stored:
+            obs.inc("obs.forecast.snapshot.torn")
+            return False
+        if payload.get("version") != SNAPSHOT_VERSION:
+            obs.inc("obs.forecast.snapshot.torn")
+            return False
+        with self._lock:
+            self._cohorts.clear()
+            for key, rec in payload.get("cohorts", {}).items():
+                stats = _CohortStats()
+                for it in rec.get("iterations", []):
+                    stats.iterations.append(int(it))
+                for s in rec.get("spi", []):
+                    stats.spi.append(float(s))
+                self._cohorts[key] = stats
+            self._errs.clear()
+            for e in payload.get("errs", []):
+                self._errs.append(float(e))
+        obs.inc("obs.forecast.snapshot.loads")
+        return True
+
+
+# -- the fleet scoreboard ------------------------------------------------
+
+def _flatten_metrics(metrics: dict) -> Dict[str, object]:
+    """Normalize either registry shape to one flat dict keyed by the
+    PROMETHEUS metric name (``poisson_tpu_…``):
+
+    - ``obs.metrics.snapshot()`` output (``{"counters": …,
+      "gauges": …}`` with dotted names),
+    - ``obs.metrics.load_dir()``/``merge()`` output (summed
+      ``counters`` plus per-rank ``gauges_by_rank`` — rank-sorted,
+      first rank's gauge wins a collision), or
+    - ``obs.export.parse_text`` output
+      (``{prom_name: {"type", "value"}}``).
+
+    Using the Prometheus spelling as the canonical key means the same
+    scoreboard code reads a live endpoint and a dead process's
+    snapshot dir."""
+    from poisson_tpu.obs.export import metric_name
+
+    flat: Dict[str, object] = {}
+    if ("counters" in metrics or "gauges" in metrics
+            or "gauges_by_rank" in metrics):
+        for section in ("counters", "gauges"):
+            for name, value in (metrics.get(section) or {}).items():
+                flat[metric_name(name)] = value
+        by_rank = metrics.get("gauges_by_rank") or {}
+        for rank in sorted(by_rank):
+            for name, value in (by_rank[rank] or {}).items():
+                flat.setdefault(metric_name(name), value)
+    else:
+        for name, rec in metrics.items():
+            flat[name] = rec.get("value") if isinstance(rec, dict) else rec
+    return flat
+
+
+def _get(flat: Dict[str, object], dotted: str, default=None):
+    from poisson_tpu.obs.export import metric_name
+
+    return flat.get(metric_name(dotted), default)
+
+
+def _hit_rate(flat: Dict[str, object], prefix: str) -> Optional[float]:
+    hits = _get(flat, prefix + ".hits")
+    misses = _get(flat, prefix + ".misses")
+    if hits is None and misses is None:
+        return None
+    h = float(hits or 0)
+    m = float(misses or 0)
+    total = h + m
+    return (h / total) if total > 0 else None
+
+def _prefix_scan(flat: Dict[str, object],
+                 dotted_prefix: str) -> Dict[str, object]:
+    """Every metric under a dotted prefix (burn-rate windows, per-
+    reason shed counters…), keyed by the suffix after the prefix."""
+    from poisson_tpu.obs.export import metric_name
+
+    prom_prefix = metric_name(dotted_prefix)
+    out: Dict[str, object] = {}
+    for name, value in flat.items():
+        if name.startswith(prom_prefix + "_"):
+            suffix = name[len(prom_prefix) + 1:]
+            if isinstance(value, dict):
+                continue        # histogram-shaped: not a scalar cell
+            out[suffix] = value
+    return out
+
+
+def build_scoreboard(metrics: dict) -> dict:
+    """Reduce a metrics registry (either shape — see
+    :func:`_flatten_metrics`) to the ``top`` scoreboard sections.
+    Every cell is best-effort: a metric a process never emitted
+    renders as None, the section still appears (a dead process's
+    artifacts are exactly such a partial registry)."""
+    flat = _flatten_metrics(metrics)
+    queue = {
+        "depth": _get(flat, "serve.queue_depth"),
+        "load_level": _get(flat, "serve.load_level"),
+        "shed_rate": _get(flat, "serve.shed_rate"),
+        "eta_backlog_seconds": _get(flat, "serve.forecast.backlog_seconds"),
+        "lost_requests": _get(flat, "serve.lost_requests"),
+    }
+    lanes = {
+        "active_lanes": _get(flat, "serve.refill.active_lanes"),
+        "dispatches": _get(flat, "serve.dispatches"),
+        "workers_alive": _get(flat, "serve.placement.alive"),
+        "devices": _get(flat, "serve.placement.devices"),
+    }
+    breakers = {
+        "trips": _get(flat, "serve.breaker.trips"),
+        "half_opens": _get(flat, "serve.breaker.half_opens"),
+        "closes": _get(flat, "serve.breaker.closes"),
+    }
+    slo = {
+        "good": _get(flat, "serve.slo.good"),
+        "bad": _get(flat, "serve.slo.bad"),
+        "budget_remaining": _get(flat, "serve.slo.budget_remaining"),
+        "burn_rates": _prefix_scan(flat, "serve.slo.burn_rate"),
+    }
+    caches = {
+        "canvas": _hit_rate(flat, "geom.cache"),
+        "bucket": _hit_rate(flat, "batched.bucket_cache"),
+        "krylov": _hit_rate(flat, "krylov.cache"),
+        "hierarchy": _hit_rate(flat, "mg.hierarchy_cache"),
+    }
+    placement = {
+        "epoch": _get(flat, "serve.placement.epoch"),
+        "rebinds": _get(flat, "serve.placement.rebinds"),
+        "replans": _get(flat, "serve.placement.replans"),
+    }
+    forecast = {
+        "predictions": _get(flat, "obs.forecast.predictions"),
+        "cold_cohorts": _get(flat, "obs.forecast.cold_cohorts"),
+        "abs_err_pct": _get(flat, "obs.forecast.abs_err_pct"),
+        "calibration_err_pct":
+            _get(flat, "obs.forecast.calibration_err_pct"),
+        "predicted_deadline_sheds":
+            _get(flat, "serve.shed.predicted_deadline"),
+        "preempted": _get(flat, "serve.forecast.preempted"),
+    }
+    return {
+        "queue": queue,
+        "lanes": lanes,
+        "breakers": breakers,
+        "slo": slo,
+        "caches": caches,
+        "placement": placement,
+        "forecast": forecast,
+    }
+
+
+def _cell(value, fmt: str = "{}") -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        if fmt == "{}" and value == int(value):
+            return str(int(value))      # counters read as floats
+        return fmt.format(value)
+    return str(value)
+
+
+def render_scoreboard(board: dict) -> str:
+    """One stdlib screen of the scoreboard — fixed-width sections, no
+    curses, safe to pipe."""
+    q, ln = board["queue"], board["lanes"]
+    br, slo = board["breakers"], board["slo"]
+    ca, pl, fc = board["caches"], board["placement"], board["forecast"]
+    lines = [
+        "poisson_tpu fleet scoreboard",
+        "=" * 64,
+        (f"queue     depth {_cell(q['depth'])}"
+         f"  level {_cell(q['load_level'])}"
+         f"  shed_rate {_cell(q['shed_rate'], '{:.3f}')}"
+         f"  eta_backlog {_cell(q['eta_backlog_seconds'], '{:.3f}')}s"
+         f"  lost {_cell(q['lost_requests'])}"),
+        (f"lanes     active {_cell(ln['active_lanes'])}"
+         f"  dispatches {_cell(ln['dispatches'])}"
+         f"  workers {_cell(ln['workers_alive'])}"
+         f"  devices {_cell(ln['devices'])}"),
+        (f"breakers  trips {_cell(br['trips'])}"
+         f"  half_opens {_cell(br['half_opens'])}"
+         f"  closes {_cell(br['closes'])}"),
+        (f"slo       good {_cell(slo['good'])}  bad {_cell(slo['bad'])}"
+         f"  budget {_cell(slo['budget_remaining'], '{:.3f}')}"
+         + "".join(f"  burn[{w}] {_cell(v, '{:.2f}')}"
+                   for w, v in sorted(slo["burn_rates"].items()))),
+        ("caches    "
+         + "  ".join(f"{name} {_cell(rate, '{:.0%}')}"
+                     for name, rate in ca.items())),
+        (f"placement epoch {_cell(pl['epoch'])}"
+         f"  rebinds {_cell(pl['rebinds'])}"
+         f"  replans {_cell(pl['replans'])}"),
+        (f"forecast  predictions {_cell(fc['predictions'])}"
+         f"  cold {_cell(fc['cold_cohorts'])}"
+         f"  p50_err {_cell(fc['calibration_err_pct'], '{:.1f}')}%"
+         f"  pred_sheds {_cell(fc['predicted_deadline_sheds'])}"
+         f"  preempted {_cell(fc['preempted'])}"),
+    ]
+    return "\n".join(lines)

@@ -359,6 +359,49 @@ counters and numeric gauges in Prometheus text format):
   multi-window alerting ANDs a short and a long window);
   ``serve.slo.objective_seconds`` echoes the declared latency
   objective so the exposition is self-describing.
+
+- the ``obs.forecast`` family — the convergence observatory
+  (:mod:`poisson_tpu.obs.forecast`): counters
+  ``obs.forecast.predictions`` (completed solves graded against the
+  prediction that was live at their admission — one predict-then-
+  compare each), ``obs.forecast.cold_cohorts`` (gradings where the
+  prediction came from the analytic √(M·N)/bandwidth seed because the
+  cohort had no samples yet — a high rate means traffic never
+  repeats, so ETAs are model-quality, not measured),
+  ``obs.forecast.snapshot.saves`` / ``obs.forecast.snapshot.loads``
+  (CRC-sealed forecast snapshots written beside the journal / warm-
+  loaded on recovery), ``obs.forecast.snapshot.torn`` (snapshots
+  rejected at load for CRC/shape/version mismatch — the model starts
+  cold AUDIBLY, a corrupt forecast never poisons admission), and
+  ``obs.forecast.snapshot.write_errors`` (save attempts that failed
+  on disk — durability degraded, audibly). Gauges:
+  ``obs.forecast.abs_err_pct`` (the most recent grading's absolute
+  iteration-count error, percent of actual),
+  ``obs.forecast.calibration_err_pct`` (the running p50 absolute
+  error — THE calibration figure; ``bench.py --serve`` stamps it on
+  every record and ``regress.py`` lifts it into the sentinel cohort
+  with a lower-is-better pin), and ``obs.forecast.calibration_pct`` —
+  a real histogram of per-solve absolute percent errors (the same
+  ``{"le": …, "sum": …, "count": …}`` shape as
+  ``serve.slo.latency_seconds``, rendered as a Prometheus histogram)
+  so calibration drift is re-thresholdable at scrape time.
+
+- the ``serve.forecast`` family — predicted-deadline admission
+  (``ServicePolicy.forecast``): ``serve.forecast.admission_checks``
+  (requests whose deadline was compared against the cohort's p90 ETA
+  at submit), ``serve.shed.predicted_deadline`` (the typed shed: the
+  p90 ETA exceeded the deadline × margin, so the request was refused
+  BEFORE any dispatch — zero compute burned, the counter the chaos
+  drill asserts), ``serve.forecast.preempted`` (admitted deadline
+  work retired early at a lane/chunk boundary because the re-forecast
+  — measured log-residual slope over the remaining budget — said the
+  deadline cannot be met; each also sheds typed
+  ``predicted_deadline``), ``serve.forecast.backlog_seconds`` (gauge:
+  the queue's summed p50 ETAs — backlog measured in work-seconds,
+  not request count), and ``serve.degraded.backlog_driven`` (ladder
+  rungs chosen because ETA backlog, not raw depth, crossed the
+  fraction — the forecast-aware sibling of
+  ``serve.degraded.slo_driven``).
 """
 
 from __future__ import annotations

@@ -803,6 +803,62 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in session_parsed:
             return _fail(f"exposition lost the {prom_name} counter")
 
+    # 22. Convergence forecasting end to end (runs LAST of all, clean
+    # registry): the analytic cold model seeds a prediction before any
+    # sample exists, a few completed solves calibrate the cohort, a
+    # deadline-doomed request sheds typed `predicted_deadline` at
+    # admission with ZERO compute burned (counter-asserted), and the
+    # forecast counters survive the Prometheus exposition round trip.
+    from poisson_tpu.obs.forecast import ForecastModel
+    from poisson_tpu.serve import ForecastPolicy
+
+    obs_metrics.reset()
+    model22 = ForecastModel()
+    fc_cold22 = model22.predict("seed-cohort", M=problem.M, N=problem.N,
+                                dtype_bytes=8, scaled=False)
+    if not fc_cold22.cold or fc_cold22.iterations_p50 < 1 \
+            or fc_cold22.eta_p90_seconds <= 0.0:
+        return _fail(f"cold-seed forecast degenerate: {fc_cold22}")
+    svc22 = SolveService(
+        ServicePolicy(capacity=16, forecast=ForecastPolicy()), seed=0)
+    for k in range(3):
+        if svc22.submit(SolveRequest(request_id=f"fc{k}",
+                                     problem=problem)) is not None:
+            return _fail("forecast warm-up request shed on admission")
+    outs22 = svc22.drain()
+    if not all(o.converged for o in outs22):
+        return _fail(f"forecast warm-up solves did not converge: "
+                     f"{[o.kind for o in outs22]}")
+    preds22 = obs_metrics.get("obs.forecast.predictions")
+    calib22 = obs_metrics.get("obs.forecast.calibration_err_pct")
+    if preds22 < 3:
+        return _fail(f"forecast feedback missing: "
+                     f"obs.forecast.predictions={preds22}")
+    if calib22 > 25.0:
+        return _fail(f"forecast stayed uncalibrated on repeat traffic: "
+                     f"p50 abs error {calib22}% > 25%")
+    doomed22 = svc22.submit(SolveRequest(request_id="fc-doom",
+                                         problem=problem,
+                                         deadline_seconds=1e-9))
+    if doomed22 is None or doomed22.kind != "shed" \
+            or doomed22.shed_reason != "predicted_deadline":
+        return _fail(f"deadline-doomed request was not predict-shed: "
+                     f"{doomed22}")
+    d22 = doomed22.decomposition or {}
+    if d22.get("compute_s", 1) != 0 or d22.get("dispatches", 1) != 0:
+        return _fail(f"predicted shed burned compute: {d22}")
+    st22 = svc22.stats()
+    if st22["lost"] != 0:
+        return _fail(f"forecast service lost requests: {st22}")
+    parsed22 = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_obs_forecast_predictions",
+                      "poisson_tpu_obs_forecast_cold_cohorts",
+                      "poisson_tpu_obs_forecast_calibration_err_pct",
+                      "poisson_tpu_serve_forecast_admission_checks",
+                      "poisson_tpu_serve_shed_predicted_deadline"):
+        if prom_name not in parsed22:
+            return _fail(f"exposition lost the {prom_name} metric")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -827,7 +883,9 @@ def run_selfcheck(out_dir: str) -> int:
           f"(cold {int(cold20.iterations)} -> warm "
           f"{int(warm20.iterations)} it, {int(saved20)} saved), "
           f"solver sessions ok (warm {warm_it21} vs cold {cold_it21} "
-          f"it, boundary replay closed {int(adm21)}/{int(done21)}) "
+          f"it, boundary replay closed {int(adm21)}/{int(done21)}), "
+          f"forecasting ok ({int(preds22)} predictions, p50 err "
+          f"{calib22:.1f}%, predicted-deadline shed with 0 compute) "
           f"({out_dir})")
     return 0
 

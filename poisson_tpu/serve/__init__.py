@@ -88,6 +88,7 @@ from poisson_tpu.serve.types import (
     BreakerPolicy,
     DegradationPolicy,
     FleetPolicy,
+    ForecastPolicy,
     Outcome,
     RetryPolicy,
     SCHED_CONTINUOUS,
@@ -96,6 +97,7 @@ from poisson_tpu.serve.types import (
     SessionPolicy,
     SHED_BREAKER_OPEN,
     SHED_DEADLINE_EXPIRED,
+    SHED_PREDICTED_DEADLINE,
     SHED_QUEUE_FULL,
     SLOPolicy,
     SolveRequest,
@@ -107,7 +109,8 @@ __all__ = [
     "DegradationPolicy", "DeviceLossError", "DeviceRegistry",
     "ERROR_DIVERGENCE", "ERROR_INTEGRITY",
     "ERROR_INTERNAL", "ERROR_PLACEMENT",
-    "ERROR_TRANSIENT", "FleetPolicy", "HALF_OPEN", "IntegrityPolicy",
+    "ERROR_TRANSIENT", "FleetPolicy", "ForecastPolicy",
+    "HALF_OPEN", "IntegrityPolicy",
     "JournalReplay", "KrylovPolicy",
     "OPEN", "Outcome", "OUTCOME_ERROR",
     "OUTCOME_RESULT", "OUTCOME_SHED", "PendingRequest", "Placement",
@@ -115,7 +118,8 @@ __all__ = [
     "RUNG_MESH", "RUNG_SHED", "RUNG_SINGLE",
     "SCHED_CONTINUOUS", "SCHED_DRAIN", "ServicePolicy",
     "SessionHost", "SessionPolicy", "SessionReplay",
-    "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED", "SHED_QUEUE_FULL",
+    "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED",
+    "SHED_PREDICTED_DEADLINE", "SHED_QUEUE_FULL",
     "SLOPolicy", "SolveJournal", "SolveRequest", "SolveService",
     "SolveSession",
     "TransientDispatchError", "WORKER_DEAD", "WORKER_QUARANTINED",

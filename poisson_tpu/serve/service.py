@@ -70,9 +70,11 @@ from poisson_tpu import obs
 from poisson_tpu.obs.costs import apportion_compute
 from poisson_tpu.obs.flight import (
     POINT_DEADLINE,
+    POINT_FORECAST_SHED,
     POINT_PLACEMENT,
     POINT_QUARANTINE,
     POINT_RECOVERED,
+    POINT_REFORECAST,
     POINT_RETRY,
     POINT_WARM_FALLBACK,
     SPAN_BACKOFF,
@@ -111,6 +113,7 @@ from poisson_tpu.serve.types import (
     ServicePolicy,
     SHED_BREAKER_OPEN,
     SHED_DEADLINE_EXPIRED,
+    SHED_PREDICTED_DEADLINE,
     SHED_QUEUE_FULL,
     SolveRequest,
     TransientDispatchError,
@@ -122,7 +125,8 @@ class _Entry:
 
     __slots__ = ("request", "admitted_at", "deadline", "attempts",
                  "taint", "taint_fp", "not_before", "escalate",
-                 "last_failure", "iter_cap", "recovered")
+                 "last_failure", "iter_cap", "recovered",
+                 "eta", "history", "spi")
 
     def __init__(self, request: SolveRequest, admitted_at: float,
                  deadline: Optional[Deadline]):
@@ -141,6 +145,9 @@ class _Entry:
         self.last_failure = ""
         self.iter_cap = None       # degraded per-member cap (lane splices)
         self.recovered = False     # pulled off a dead worker / the journal
+        self.eta = None            # admission forecast p50 ETA (seconds)
+        self.history = []          # (k, diff) lane-boundary residual ring
+        self.spi = 0.0             # measured seconds/iteration (this entry)
 
 
 def _geo_fps(entries) -> set:
@@ -279,6 +286,20 @@ class SolveService:
         # unconfigured.
         self._flight = FlightRecorder(clock=clock)
         self._slo = SLOTracker(self.policy.slo, clock=clock)
+        # Iteration forecaster (obs.forecast, ServicePolicy.forecast):
+        # per-cohort iteration/cost estimator behind predicted-deadline
+        # admission, lane re-forecast preemption, and the ETA backlog
+        # gauge. Journal-adjacent snapshot warm-loads across restarts —
+        # a recovered service predicts from its previous life's
+        # calibration instead of re-entering the cold-model regime.
+        self._forecast = None
+        if self.policy.forecast is not None:
+            from poisson_tpu.obs.forecast import (ForecastModel,
+                                                  snapshot_path)
+
+            self._forecast = ForecastModel()
+            if self._journal is not None:
+                self._forecast.load(snapshot_path(self._journal.path))
         if self._journal is not None:
             # The journal opens with this incarnation's topology, so a
             # recovery on a DIFFERENT topology can see the change and
@@ -426,6 +447,26 @@ class SolveService:
             return self._shed(entry, SHED_QUEUE_FULL,
                               "admission queue at capacity "
                               f"({self.policy.capacity})")
+        if self._forecast is not None:
+            fc = self._forecast_predict(request)
+            entry.eta = fc.eta_p50_seconds
+            fp = self.policy.forecast
+            if fp.admission_shed and deadline is not None:
+                # Predicted-deadline admission: a request whose p90 ETA
+                # already exceeds its budget is shed HERE, typed, with
+                # zero compute burned — never admitted-then-doomed.
+                obs.inc("serve.forecast.admission_checks")
+                if fc.eta_p90_seconds * fp.margin > request.deadline_seconds:
+                    self._flight.point(
+                        request.request_id, POINT_FORECAST_SHED,
+                        eta=round(fc.eta_p90_seconds, 6),
+                        deadline=request.deadline_seconds)
+                    return self._shed(
+                        entry, SHED_PREDICTED_DEADLINE,
+                        f"p90 ETA {fc.eta_p90_seconds:.3g}s exceeds "
+                        f"deadline {request.deadline_seconds:.3g}s "
+                        f"(cohort {fc.cohort}, "
+                        f"{'cold' if fc.cold else 'calibrated'} model)")
         self._pending_ids.add(request.request_id)
         self._flight.begin(request.request_id, SPAN_QUEUE)
         self._queue.append(entry)
@@ -891,6 +932,85 @@ class SolveService:
         # cohort string still never carries the fingerprint.)
         return base + (":geo" if request.geometry is not None else "")
 
+    # -- convergence forecasting (obs.forecast) ------------------------
+
+    def _forecast_args(self, request: SolveRequest) -> dict:
+        """The cohort-model keyword set for this request — the cold
+        analytic seed needs the grid, precision, and device kind the
+        dispatch would actually run with."""
+        from poisson_tpu.solvers.pcg import resolve_dtype
+
+        dtype = resolve_dtype(request.dtype)
+        p = request.problem
+        return {
+            "M": p.M, "N": p.N,
+            "dtype_bytes": 8 if dtype == "float64" else 4,
+            "scaled": dtype != "float64",
+            "device_kind": self._hw_cohort()[1],
+        }
+
+    def _forecast_predict(self, request: SolveRequest):
+        return self._forecast.predict(self._cohort(request),
+                                      **self._forecast_args(request))
+
+    def _forecast_observe(self, entry: _Entry, iterations: int,
+                          compute_s: float) -> None:
+        """Feed one completed solve back into the cohort model and
+        persist the snapshot beside the journal (best-effort, atomic) so
+        a recovered service warm-starts its calibration."""
+        self._forecast.observe(self._cohort(entry.request),
+                               iterations, compute_s,
+                               **self._forecast_args(entry.request))
+        if self._journal is not None:
+            from poisson_tpu.obs.forecast import snapshot_path
+
+            self._forecast.save(snapshot_path(self._journal.path))
+
+    def _forecast_backlog(self) -> float:
+        """Predicted seconds of queued work — the sum of every waiting
+        entry's admission-time p50 ETA. The degradation ladder's
+        backlog-seconds rung keys on this, and it is published as
+        ``serve.forecast.backlog_seconds`` either way."""
+        backlog = sum(e.eta or 0.0 for e in self._queue)
+        backlog += sum(e.eta or 0.0 for e in self._delayed)
+        obs.gauge("serve.forecast.backlog_seconds", round(backlog, 6))
+        return backlog
+
+    def _reforecast_doomed(self, entry: _Entry, view, table) -> bool:
+        """Mid-flight ETA check for a lane occupant: fit the convergence
+        rate to the entry's lane-boundary residual history, extrapolate
+        iterations-to-δ, and price them with the entry's own measured
+        seconds/iteration (cohort/analytic model when unmeasured — the
+        VirtualClock case). Unknown rate never preempts: blind eviction
+        of converging work would be worse than a deadline partial."""
+        from poisson_tpu.obs import forecast as fcast
+
+        slope = fcast.log_residual_slope(entry.history)
+        rem = fcast.remaining_iterations(float(view["diff"]),
+                                         float(table.problem.delta),
+                                         slope)
+        if rem is None:
+            return False
+        spi = entry.spi
+        if spi <= 0.0:
+            spi = self._forecast_predict(
+                entry.request).seconds_per_iteration
+        eta = rem * spi
+        remaining = entry.deadline.remaining()
+        if remaining is None:
+            return False
+        rid = entry.request.request_id
+        self._flight.annotate(
+            rid, SPAN_RESIDENT, eta=round(eta, 6),
+            progress=round(fcast.progress_fraction(
+                int(view["k"]), int(view["k"]) + rem), 3))
+        doomed = eta * self.policy.forecast.margin > max(0.0, remaining)
+        if doomed:
+            self._flight.point(rid, POINT_REFORECAST, k=int(view["k"]),
+                               eta=round(eta, 6),
+                               remaining=round(max(0.0, remaining), 6))
+        return doomed
+
     def _hw_cohort(self) -> tuple:
         """The (backend, device_kind, device_id) triple integrity
         suspicion taints — hardware identity at placement granularity:
@@ -1047,6 +1167,26 @@ class SolveService:
         if slo_level > level:
             obs.inc("serve.degraded.slo_driven")
             level = slo_level
+        # Predicted-backlog rung (opt-in, ForecastPolicy
+        # .backlog_degradation): the ladder can respond to SECONDS of
+        # queued work, not only request count — ten 4096² solves are a
+        # deeper backlog than a hundred 64² ones. The backlog objective
+        # normalizes ETA-seconds onto the same fractional thresholds the
+        # depth rungs use; audible as its own counter.
+        fp = self.policy.forecast
+        if self._forecast is not None and fp.backlog_degradation:
+            bfrac = (self._forecast_backlog()
+                     / max(1e-9, fp.backlog_objective_seconds))
+            blevel = 0
+            if bfrac >= d.shrink_padding_at:
+                blevel = 1
+            if bfrac >= d.cap_iterations_at:
+                blevel = 2
+            if bfrac >= d.downshift_precision_at:
+                blevel = 3
+            if blevel > level:
+                obs.inc("serve.degraded.backlog_driven")
+                level = blevel
         return level
 
     # -- continuous batching (lane table + refill state machine) -------
@@ -1360,9 +1500,25 @@ class SolveService:
                      for lane, dk in deltas.items()}
         shares = apportion_compute(secs, by_member)
         for lane, dk in deltas.items():
-            rid = table.entries[lane].request.request_id
+            entry = table.entries[lane]
+            rid = entry.request.request_id
             self._flight.add_step(rid, secs, dk, shares[rid], did,
                                   k=views[lane]["k"])
+            # Per-member iteration delta on the resident span: timelines
+            # render iterations/chunk without decoding the step points.
+            self._flight.annotate(rid, SPAN_RESIDENT, dk=int(dk),
+                                  k=int(views[lane]["k"]))
+            if self._forecast is not None and dk > 0:
+                # Lane-boundary residual history: each member reports
+                # its own (k, ‖Δw‖) pair from the lane view — the
+                # re-forecast slope rides chunk boundaries, LaneBatch
+                # members individually.
+                entry.history.append(
+                    (int(views[lane]["k"]), float(views[lane]["diff"])))
+                if len(entry.history) > 32:
+                    del entry.history[0]
+                if shares[rid] > 0.0:
+                    entry.spi = shares[rid] / dk
         self._retire_boundary(table, breaker, views)
 
     def _retire_boundary(self, table, breaker, views) -> None:
@@ -1381,6 +1537,33 @@ class SolveService:
             deadline_hit = (entry.deadline is not None
                             and entry.deadline.expired())
             if not (view["done"] or view["k"] >= cap or deadline_hit):
+                # Lane-boundary re-forecast (ForecastPolicy.reforecast):
+                # a converging-but-doomed occupant — remaining-iterations
+                # ETA past its remaining budget — is preempted NOW,
+                # freeing the lane for work that can still make its
+                # deadline, instead of burning chunks to an inevitable
+                # deadline-flagged partial.
+                if (self._forecast is not None
+                        and self.policy.forecast.reforecast
+                        and entry.deadline is not None
+                        and self._reforecast_doomed(entry, view, table)):
+                    entry, result = table.retire(view["lane"])
+                    if self._journal is not None:
+                        self._journal.record(
+                            "retire",
+                            request_id=str(entry.request.request_id),
+                            iterations=int(result.iterations),
+                            flag=result.flag_name)
+                    self._flight.end(entry.request.request_id,
+                                     SPAN_RESIDENT,
+                                     iterations=result.iterations,
+                                     flag=result.flag_name)
+                    obs.inc("serve.forecast.preempted")
+                    # Preemption is a capacity decision, not a cohort
+                    # fault: the breaker never hears about it.
+                    self._shed(entry, SHED_PREDICTED_DEADLINE,
+                               "re-forecast ETA exceeds remaining "
+                               f"deadline budget at k={int(view['k'])}")
                 continue               # still ACTIVE: rides the next chunk
             entry, result = table.retire(view["lane"])
             if self._journal is not None:
@@ -1729,6 +1912,8 @@ class SolveService:
                           else None),
                 verify_every=verify_every, verify_tol=verify_tol,
                 preconditioner=self._precond(req),
+                history=(self._forecast is not None
+                         and self.policy.forecast.history_every > 0),
             )
         # Flight: a solo dispatch's whole wall is this member's compute
         # (it shares the program with nobody).
@@ -2005,6 +2190,15 @@ class SolveService:
                 <= self.policy.slo.latency_objective_seconds)
         fo = self._close_flight(entry, OUTCOME_RESULT, flag, latency,
                                 entry.attempts + 1, good)
+        if self._forecast is not None and converged and not partial:
+            # Only full converged solves calibrate the cohort model —
+            # a deadline partial's iteration count measures the budget,
+            # not the problem. compute_s is the flight decomposition's
+            # measured per-request compute share.
+            self._forecast_observe(
+                entry, int(iterations),
+                float((fo.get("decomposition") or {})
+                      .get("compute_s", 0.0)))
         return self._record(Outcome(
             request_id=entry.request.request_id, kind=OUTCOME_RESULT,
             flag=flag, converged=converged, partial=partial,
@@ -2245,3 +2439,5 @@ class SolveService:
         obs.gauge("serve.shed_rate", round(s["shed_rate"], 6))
         obs.gauge("serve.queue_depth", s["pending"])
         obs.gauge("serve.lost_requests", s["lost"])
+        if self._forecast is not None:
+            self._forecast_backlog()

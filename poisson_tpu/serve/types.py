@@ -55,6 +55,11 @@ ERROR_PLACEMENT = "placement"
 SHED_QUEUE_FULL = "queue_full"
 SHED_BREAKER_OPEN = "breaker_open"
 SHED_DEADLINE_EXPIRED = "deadline_expired"
+# The forecast guard's verdict (ServicePolicy.forecast): the cohort's
+# p90 ETA says this deadline cannot be met — refused at admission (or
+# pre-empted at a lane boundary) BEFORE burning the compute, which is
+# the whole point of forecasting.
+SHED_PREDICTED_DEADLINE = "predicted_deadline"
 
 
 class TransientDispatchError(RuntimeError):
@@ -340,6 +345,35 @@ class SessionPolicy:
     slo_seconds: float = 60.0
 
 
+@dataclasses.dataclass(frozen=True)
+class ForecastPolicy:
+    """Predicted-deadline knobs (:mod:`poisson_tpu.obs.forecast`).
+
+    ``admission_shed``: a request whose deadline is below the cohort's
+    p90 ETA × ``margin`` at submit sheds as typed
+    ``predicted_deadline`` — refused before any dispatch, never
+    admitted-then-burned. ``reforecast``: at every lane/chunk boundary
+    an admitted deadline request is re-forecast from its own measured
+    log-residual slope; hopeless work is pre-empted there (also a
+    typed ``predicted_deadline`` shed, plus
+    ``serve.forecast.preempted``). ``backlog_degradation``: the
+    degradation ladder consults ETA backlog-seconds (queued p50 ETAs
+    against ``backlog_objective_seconds``) instead of only raw queue
+    depth. The shed condition is ``eta_p90 × margin > deadline``, so
+    ``margin`` > 1 demands head-room (sheds more eagerly) and < 1
+    tolerates optimistic ETAs. ``history_every`` > 0 additionally traces
+    the residual-history callback into chunked solo dispatches
+    (``pcg_solve(history_every=…)``); 0 keeps every program
+    byte-identical and estimates from lane-boundary samples only."""
+
+    admission_shed: bool = True
+    reforecast: bool = True
+    backlog_degradation: bool = False
+    backlog_objective_seconds: float = 60.0
+    margin: float = 1.0
+    history_every: int = 0
+
+
 # Scheduling modes (ServicePolicy.scheduling):
 SCHED_DRAIN = "drain"            # PR 5 batch-drain: dispatch, wait, repeat
 SCHED_CONTINUOUS = "continuous"  # lane table + refill state machine
@@ -406,6 +440,13 @@ class ServicePolicy:
     bounds, the shed-new-sessions-first degradation rung, warm-start
     validity, per-step deadlines, and the per-session SLO. The defaults
     change nothing for session-free traffic.
+
+    ``forecast`` arms the convergence observatory
+    (:class:`ForecastPolicy` — ``poisson_tpu.obs.forecast``):
+    predicted-deadline admission, lane-boundary re-forecast
+    pre-emption, and ETA-backlog degradation. None (the default)
+    traces nothing, sheds nothing, and predicts nothing — byte- and
+    behavior-identical to every prior release.
     """
 
     capacity: int = 64
@@ -423,3 +464,4 @@ class ServicePolicy:
     integrity: IntegrityPolicy = IntegrityPolicy()
     krylov: KrylovPolicy = KrylovPolicy()
     session: SessionPolicy = SessionPolicy()
+    forecast: Optional[ForecastPolicy] = None

@@ -65,7 +65,8 @@ def _make_differentiable(problem: Problem, dtype_name: str, scaled: bool):
     def solve_fn(_matvec, rhs):
         # rhs arrives ring-projected; the scaled system takes b̃ = sc·B.
         r = rhs * aux if scaled else rhs
-        return _solve(problem, scaled, 0, 0, 0.0, False, a, b, r, aux).w
+        return _solve(problem, scaled, 0, 0, 0.0, False, 0,
+                      a, b, r, aux).w
 
     def solve(rhs_grid):
         rhs_proj = pad_interior(interior(rhs_grid))
@@ -133,7 +134,7 @@ def differentiable_geometry_solve(problem: Problem, spec, dtype=None,
         # same (traced) canvases; custom_linear_solve differentiates
         # around it implicitly, so the solver is a black box here.
         ru = r * aux if use_scaled else r
-        return _solve(problem, use_scaled, 0, 0, 0.0, False,
+        return _solve(problem, use_scaled, 0, 0, 0.0, False, 0,
                       a, b, ru, aux).w
 
     rhs_proj = pad_interior(interior(rhs))

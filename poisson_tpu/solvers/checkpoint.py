@@ -205,7 +205,7 @@ def run_chunked(state, *, advance, to_portable, path: Optional[str],
                 fingerprint: str,
                 cap: int, keep_checkpoint: bool, primary=None, sync=None,
                 keep_last: int = 2, watchdog=None, on_chunk=None,
-                deadline=None):
+                deadline=None, history: bool = False):
     """The one chunked-checkpoint driver loop, shared by all four
     checkpointed solvers (single/sharded × XLA/fused): advance until done
     or cap, persist the portable full-grid state after every chunk, clean
@@ -240,6 +240,10 @@ def run_chunked(state, *, advance, to_portable, path: Optional[str],
     - ``on_chunk(state, chunks_done)`` runs after each chunk is persisted
       and may return a replacement state or raise (fault injection — see
       ``testing.faults``);
+    - ``history`` feeds each chunk boundary's ``(k, ‖Δw‖)`` into the
+      forecast residual-history buffer (``obs.forecast``) — host-side
+      only, the traced program is untouched, so the chunked dispatch
+      path reports convergence rate without recompilation;
     - a state that went non-finite is *not* persisted and the stop is not
       treated as convergence: the newest good generation survives for the
       recovery driver.
@@ -265,6 +269,10 @@ def run_chunked(state, *, advance, to_portable, path: Optional[str],
             chunks_done += 1
             if watchdog is not None:
                 watchdog.beat(k=int(state.k), diff=float(state.diff))
+            if history:
+                from poisson_tpu.obs.forecast import history_tap
+
+                history_tap(int(state.k), float(state.diff))
             flag = _state_flag(state)
             if flag in (FLAG_NONFINITE, FLAG_INTEGRITY):
                 # Poisoned state: saving it would overwrite the last good
@@ -598,7 +606,7 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
                       deadline=None, geometry=None,
                       verify_every: int = 0, verify_tol=None,
                       preconditioner: str = "jacobi",
-                      mg_config=None) -> PCGResult:
+                      mg_config=None, history: bool = False) -> PCGResult:
     """Chunked single-device solve WITHOUT persistence: the same
     chunk-boundary loop as :func:`pcg_solve_checkpointed` (watchdog beats,
     fault hooks, deadline awareness) minus the disk. This is the dispatch
@@ -616,7 +624,9 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
     the partial iterate with ``flag == FLAG_DEADLINE``.
     ``verify_every``/``verify_tol`` arm the in-loop integrity probe
     (``poisson_tpu.integrity``) — the solve service's defensive
-    verification rides this path for chunked dispatches.
+    verification rides this path for chunked dispatches. ``history``
+    taps each chunk boundary into the forecast residual-history buffer
+    (see :func:`run_chunked`).
     """
     from poisson_tpu.solvers.pcg import solve_setup
 
@@ -647,6 +657,7 @@ def pcg_solve_chunked(problem: Problem, chunk: int = 100, dtype=None,
         path=None, fingerprint="", cap=problem.iteration_cap,
         keep_checkpoint=False,
         watchdog=watchdog, on_chunk=on_chunk, deadline=deadline,
+        history=history,
     )
     w = state.w * aux if use_scaled else state.w
     return PCGResult(

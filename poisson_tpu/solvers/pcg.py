@@ -223,7 +223,8 @@ def make_pcg_member_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
                          verify_tol: float = 0.0,
                          verify_jump: Optional[float] = None,
                          verify_colsum=None,
-                         preconditioner: str = "jacobi"):
+                         preconditioner: str = "jacobi",
+                         history_every: int = 0):
     """The PCG iteration as a ``body(state, rhs) -> state`` pair-form —
     the verification-capable core :func:`make_pcg_body` wraps. The
     second argument is ONLY read when ``verify_every > 0`` (the in-loop
@@ -285,6 +286,11 @@ def make_pcg_member_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
             from poisson_tpu.obs.stream import emit_every
 
             emit_every(stream_every, s.k + 1, diff)
+
+        if history_every > 0:
+            from poisson_tpu.obs.forecast import emit_history
+
+            emit_history(history_every, s.k + 1, diff)
 
         beta = zr_new / jnp.where(s.zr == 0.0, 1.0, s.zr)
         p_new = z_new + beta * p
@@ -397,7 +403,8 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
                   verify_tol: float = 0.0,
                   verify_jump: Optional[float] = None,
                   verify_rhs=None, verify_colsum=None,
-                  preconditioner: str = "jacobi"):
+                  preconditioner: str = "jacobi",
+                  history_every: int = 0):
     """One PCG iteration as a pure state→state function — shared by the
     convergence ``while_loop`` (:func:`pcg_loop`) and the fixed-budget
     diagnostic ``scan`` (``solvers.history``).
@@ -424,7 +431,13 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
     ``stream_every`` it is a trace-time constant: at the default 0 the
     body is the exact historical program, byte-identical HLO. See
     :func:`make_pcg_member_body` for the semantics (and for the
-    ``body(state, rhs)`` pair form the batched drivers vmap)."""
+    ``body(state, rhs)`` pair form the batched drivers vmap).
+
+    ``history_every`` > 0 ships (k, ‖Δw‖) to the forecast history sink
+    (``obs.forecast``) every that many iterations — the mid-flight
+    convergence-rate seam. Identical trace-time-constant contract:
+    at the default 0 no callback is traced and the program is
+    byte-identical."""
     if verify_every > 0 and verify_rhs is None:
         raise ValueError(
             "verify_every > 0 needs verify_rhs — the in-loop integrity "
@@ -435,7 +448,7 @@ def make_pcg_body(ops: PCGOps, *, delta: float, weighted_norm: bool,
         stagnation_window=stagnation_window, stream_every=stream_every,
         verify_every=verify_every, verify_tol=verify_tol,
         verify_jump=verify_jump, verify_colsum=verify_colsum,
-        preconditioner=preconditioner,
+        preconditioner=preconditioner, history_every=history_every,
     )
     if verify_every == 0:
         return member     # vrhs defaults to None and is never read
@@ -447,7 +460,8 @@ def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
              stagnation_window: int = 0, stream_every: int = 0,
              verify_every: int = 0, verify_tol: float = 0.0,
              verify_abft: bool = False,
-             preconditioner: str = "jacobi") -> PCGState:
+             preconditioner: str = "jacobi",
+             history_every: int = 0) -> PCGState:
     """Run the PCG while_loop to convergence; backend-agnostic.
     ``verify_every``/``verify_tol`` arm the in-loop integrity probe
     against this solve's own RHS; ``verify_abft`` additionally traces
@@ -464,6 +478,7 @@ def pcg_loop(ops: PCGOps, rhs, *, delta: float, max_iter: int,
         verify_every=verify_every, verify_tol=verify_tol,
         verify_rhs=(rhs if verify_every > 0 else None),
         verify_colsum=colsum, preconditioner=preconditioner,
+        history_every=history_every,
     )
 
     def cond(s: PCGState):
@@ -587,18 +602,20 @@ def solve_setup(problem: Problem, dtype_name: str, scaled: bool,
     return geometry_setup(problem, geometry, dtype_name, scaled)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5, 6))
 def _solve(problem: Problem, scaled: bool, stream_every: int,
            verify_every: int, verify_tol: float, verify_abft: bool,
+           history_every: int,
            a, b, rhs, aux) -> PCGResult:
     """jitted solve; ``aux`` is the zero-ring-embedded D (unscaled) or
     D^{-1/2} (scaled) on the full grid. ``stream_every`` is the static
     telemetry stride (0 = no callback traced in — see ``obs.stream``);
     ``verify_every``/``verify_tol``/``verify_abft`` are the static
     integrity-probe knobs (0 = no probe traced in — see
-    ``poisson_tpu.integrity``; both strides are part of the compile
-    cache key, so flag-off programs are the exact historical
-    executables)."""
+    ``poisson_tpu.integrity``); ``history_every`` is the static
+    forecast-history stride (0 = no callback traced in — see
+    ``obs.forecast``). All strides are part of the compile cache key,
+    so flag-off programs are the exact historical executables."""
     ops = (
         scaled_single_device_ops(problem, a, b, aux)
         if scaled
@@ -612,6 +629,7 @@ def _solve(problem: Problem, scaled: bool, stream_every: int,
         stream_every=stream_every,
         verify_every=verify_every, verify_tol=verify_tol,
         verify_abft=verify_abft,
+        history_every=history_every,
     )
     w = s.w * aux if scaled else s.w
     return PCGResult(w=w, iterations=s.k, diff=s.diff, residual_dot=s.zr,
@@ -665,7 +683,7 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
               geometry=None, verify_every: int = 0,
               verify_tol=None, verify_abft: bool = False,
               preconditioner: str = "jacobi",
-              mg_config=None) -> PCGResult:
+              mg_config=None, history_every: int = 0) -> PCGResult:
     """Single-device solve (the stage0/stage1 workload, SURVEY §3.1).
 
     The iteration is jit-compiled end to end; setup runs on the host in fp64
@@ -701,10 +719,17 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
     (:mod:`poisson_tpu.mg` — near-flat iteration counts in resolution;
     the grid must coarsen, see ``mg.validate_mg_problem``).
     ``mg_config`` tunes the cycle (``mg.MGConfig``; None = defaults).
+
+    ``history_every`` > 0 ships (k, ‖Δw‖) to the forecast history sink
+    (``obs.forecast``) every that many iterations — the mid-flight
+    convergence-rate seam the ETA estimator reads. Same trace-time
+    contract as ``stream_every``: 0 (the default) traces no callback
+    and the program is byte-identical.
     """
     dtype_name = resolve_dtype(dtype)
     use_scaled = resolve_scaled(scaled, dtype_name)
     verify_every = int(verify_every)
+    history_every = int(history_every)
     tol = (resolve_verify_tol(verify_tol, dtype_name)
            if verify_every > 0 else 0.0)
     if preconditioner not in (None, "jacobi"):
@@ -724,6 +749,11 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
                 "verify_abft is wired for the jacobi path only; drop it "
                 "or use preconditioner='jacobi'"
             )
+        if history_every > 0:
+            raise ValueError(
+                "history_every is wired for the jacobi path only; drop "
+                "it or use preconditioner='jacobi'"
+            )
         a, b, rhs, aux, hier = mg_solve_setup(
             problem, dtype_name, use_scaled, geometry=geometry,
             config=cfg)
@@ -738,7 +768,7 @@ def pcg_solve(problem: Problem, dtype=None, scaled=None,
         rhs = rhs * jnp.asarray(rhs_gate, rhs.dtype)
     return _solve(problem, use_scaled, int(stream_every), verify_every,
                   tol, bool(verify_abft and verify_every > 0),
-                  a, b, rhs, aux)
+                  history_every, a, b, rhs, aux)
 
 
 def iteration_program(problem: Problem, dtype=None, scaled=None,

@@ -1936,6 +1936,63 @@ def _session_device_loss_reroute(seed: int) -> dict:
         "placement": placement})
 
 
+@scenario("forecast-predicted-shed", group="forecast")
+def _forecast_predicted_shed(seed: int) -> dict:
+    """A deadline the calibrated forecaster already prices as hopeless
+    is shed AT ADMISSION — typed ``predicted_deadline``, zero compute
+    burned (counter-asserted from the outcome's decomposition) — while
+    a feasible deadline on the same warm cohort still admits and
+    completes. The admission guard must neither burn a dispatch on
+    work it predicted dead nor replace viable work with false sheds,
+    and the ledger must close around both."""
+    from poisson_tpu.serve import (
+        ForecastPolicy,
+        OUTCOME_SHED,
+        ServicePolicy,
+        SHED_PREDICTED_DEADLINE,
+        SolveRequest,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+    svc = SolveService(
+        ServicePolicy(capacity=32, degradation=_quiet_degradation(),
+                      forecast=ForecastPolicy()),
+        clock=vc, sleep=vc.sleep, seed=seed)
+    p = _problem()
+    # Warm the cohort model: four identical solves calibrate the
+    # iteration quantiles (the VirtualClock yields no measured wall, so
+    # the ETA prices with the analytic per-iteration cost model —
+    # deterministic by construction).
+    for k in range(4):
+        svc.submit(SolveRequest(request_id=f"warm-{k}", problem=p))
+    warm = svc.drain()
+    doomed = svc.submit(SolveRequest(request_id="doomed", problem=p,
+                                     deadline_seconds=1e-7))
+    feasible = svc.submit(SolveRequest(request_id="feasible", problem=p,
+                                       deadline_seconds=3600.0))
+    done = svc.drain()
+    d = (doomed.decomposition or {}) if doomed is not None else {}
+    return _finish("forecast-predicted-shed", seed, {
+        "warm_cohort_calibrated": all(o.converged for o in warm)
+        and _counter("obs.forecast.predictions") >= 4,
+        "doomed_shed_at_admission": doomed is not None
+        and doomed.kind == OUTCOME_SHED
+        and doomed.shed_reason == SHED_PREDICTED_DEADLINE,
+        "typed_shed_counted":
+            _counter("serve.shed.predicted_deadline") == 1,
+        "zero_compute_burned": d.get("compute_s", 1) == 0
+        and d.get("dispatches", 1) == 0 and d.get("iterations", 1) == 0,
+        "feasible_twin_still_served": feasible is None
+        and any(o.request_id == "feasible" and o.converged
+                for o in done),
+        "admission_checks_counted":
+            _counter("serve.forecast.admission_checks") == 2,
+    }, {"iterations": [int(o.iterations) for o in warm],
+        "shed_message": (doomed.message if doomed is not None else None),
+        "predictions": int(_counter("obs.forecast.predictions"))})
+
+
 # -- campaign runner ----------------------------------------------------
 
 

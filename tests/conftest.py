@@ -147,6 +147,16 @@ def pytest_configure(config):
         "sentinel cohort/direction pins; CPU-fast; runs in tier-1, "
         "selectable with -m mg)",
     )
+    config.addinivalue_line(
+        "markers",
+        "forecast: convergence-observatory suite (estimator "
+        "arithmetic, snapshot CRC round-trip + torn-file audibility, "
+        "history-flag-off HLO byte-pin + golden counts, "
+        "predicted-deadline typed-shed ledger invariant under both "
+        "engines, re-forecast preemption, calibration bound, "
+        "scoreboard dual-source render, sentinel direction pins; "
+        "CPU-fast; runs in tier-1, selectable with -m forecast)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

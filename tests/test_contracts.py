@@ -412,7 +412,7 @@ def test_ledger_detects_a_mutated_flag_off_program():
 
     p = Problem(M=20, N=24)
     a, b, rhs, aux = host_setup(p, "float64", False)
-    mutated = _solve.lower(p, False, 5, 0, 0.0, False,
+    mutated = _solve.lower(p, False, 5, 0, 0.0, False, 0,
                            a, b, rhs, aux).as_text()
     assert find_forbidden(mutated, markers_for(("callbacks",)))
     committed = load_ledger()["entries"]["solve.jacobi_f64"]

@@ -1,0 +1,553 @@
+"""Convergence observatory: online iteration forecasting, the
+predicted-deadline admission/preemption seam, and the fleet scoreboard
+(tier-1, CPU-deterministic; -m forecast).
+
+Four layers under test: the streaming estimator arithmetic
+(:mod:`poisson_tpu.obs.forecast` — log-residual slopes, cold analytic
+seeds, CRC-sealed snapshots), the opt-in ``history_every`` residual tap
+and its flag-off byte-identity contract, the service-side
+``ForecastPolicy`` lifecycle (typed ``predicted_deadline`` sheds with
+ZERO compute burned, lane-boundary re-forecast preemption, ETA backlog
+degradation), and the ``python -m poisson_tpu top`` scoreboard reading
+the same numbers live or post-mortem. Timing-dependent behaviour runs
+on an injected :class:`VirtualClock`, so every assertion is a pure
+function of the campaign seed.
+"""
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from poisson_tpu.config import Problem
+from poisson_tpu.obs import forecast, metrics
+from poisson_tpu.obs import flight
+from poisson_tpu.serve import (
+    ForecastPolicy,
+    OUTCOME_SHED,
+    SCHED_CONTINUOUS,
+    SHED_PREDICTED_DEADLINE,
+    DegradationPolicy,
+    ServicePolicy,
+    SolveJournal,
+    SolveRequest,
+    SolveService,
+)
+from poisson_tpu.testing.chaos import VirtualClock
+
+pytestmark = pytest.mark.forecast
+
+P40 = Problem(M=40, N=40)          # converges in 50 iterations (golden)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset()
+    forecast.set_history(None)
+    yield
+    metrics.reset()
+    forecast.set_history(None)
+
+
+def _service(policy, **kw):
+    vc = VirtualClock()
+    svc = SolveService(policy, clock=vc, sleep=vc.sleep, **kw)
+    return svc, vc
+
+
+def _quiet_degradation():
+    return DegradationPolicy(shrink_padding_at=9.0, cap_iterations_at=9.0,
+                             downshift_precision_at=9.0)
+
+
+# -- estimator arithmetic ------------------------------------------------
+
+
+def test_log_residual_slope_recovers_geometric_decay():
+    s = -0.3
+    samples = [(k, 2.0 * math.exp(s * k)) for k in (5, 10, 15, 20, 25)]
+    fit = forecast.log_residual_slope(samples)
+    assert fit == pytest.approx(s, rel=1e-9)
+
+
+def test_log_residual_slope_unfittable_cases():
+    assert forecast.log_residual_slope([]) is None
+    assert forecast.log_residual_slope([(10, 1e-3)]) is None
+    # non-positive residuals are unusable in log space and are dropped
+    assert forecast.log_residual_slope([(5, 0.0), (10, -1.0)]) is None
+    # identical abscissae: zero variance in k, no fit
+    assert forecast.log_residual_slope([(7, 1e-2), (7, 1e-3)]) is None
+
+
+def test_remaining_iterations_closed_form():
+    slope = -0.2
+    diff, delta = 1e-2, 1e-6
+    rem = forecast.remaining_iterations(diff, delta, slope)
+    assert rem == math.ceil(math.log(delta / diff) / slope)
+    # already converged: nothing remaining
+    assert forecast.remaining_iterations(1e-8, 1e-6, slope) == 0
+
+
+def test_remaining_iterations_never_guesses():
+    # unknown or non-contracting slope must never predict (a blind
+    # preemption would be worse than a deadline partial)
+    assert forecast.remaining_iterations(1e-2, 1e-6, None) is None
+    assert forecast.remaining_iterations(1e-2, 1e-6, 0.0) is None
+    assert forecast.remaining_iterations(1e-2, 1e-6, 0.1) is None
+    assert forecast.remaining_iterations(0.0, 1e-6, -0.1) is None
+    assert forecast.remaining_iterations(1e-2, 0.0, -0.1) is None
+
+
+def test_progress_fraction_clamps():
+    assert forecast.progress_fraction(0, 100) == 0.0
+    assert forecast.progress_fraction(50, 100) == pytest.approx(0.5)
+    assert forecast.progress_fraction(140, 100) == 1.0
+    assert forecast.progress_fraction(5, 0) == 0.0
+
+
+def test_cold_seeds_scale_with_the_grid():
+    # sqrt(M*N): the O(n) Jacobi-PCG iteration law on an n-by-n grid
+    assert forecast.cold_iterations(40, 40) == 40
+    assert forecast.cold_iterations(20, 24) == round(math.sqrt(480))
+    small = forecast.cold_seconds_per_iteration(40, 40)
+    big = forecast.cold_seconds_per_iteration(400, 600)
+    assert 0.0 < small < big
+    # f32 halves the bytes moved per sweep
+    f32 = forecast.cold_seconds_per_iteration(40, 40, dtype_bytes=4)
+    assert f32 < small
+
+
+def test_quantile_nearest_rank():
+    vals = [1.0, 2.0, 3.0, 4.0, 10.0]
+    assert forecast._quantile(vals, 0.5) == 3.0
+    assert forecast._quantile(vals, 0.9) == 10.0
+    assert forecast._quantile([7.0], 0.5) == 7.0
+
+
+def test_history_buffer_capture_and_slope():
+    buf = forecast.HistoryBuffer()
+    for k in (5, 10, 15):
+        buf.emit(k, math.exp(-0.1 * k))
+    assert buf.slope() == pytest.approx(-0.1, rel=1e-9)
+    prev = forecast.set_history(buf)
+    assert prev is None and forecast.get_history() is buf
+    forecast.history_tap(20, math.exp(-2.0))
+    assert len(buf.samples) == 4
+    forecast.set_history(None)
+    forecast.history_tap(25, 1e-3)      # sink detached: a silent no-op
+    assert len(buf.samples) == 4
+
+
+# -- snapshot persistence ------------------------------------------------
+
+
+def test_snapshot_roundtrip_preserves_calibration(tmp_path):
+    path = str(tmp_path / "journal.forecast.json")
+    model = forecast.ForecastModel()
+    for it in (48, 50, 52, 50):
+        model.predict("c", M=40, N=40)
+        model.observe("c", it, 0.01, M=40, N=40)
+    assert model.save(path) and os.path.exists(path)
+    warm = forecast.ForecastModel()
+    assert warm.load(path) is True
+    fc = warm.predict("c", M=40, N=40)
+    assert fc.cold is False and fc.samples == 4
+    assert fc.iterations_p50 == \
+        model.predict("c", M=40, N=40).iterations_p50
+    assert metrics.get("obs.forecast.snapshot.saves") == 1
+    assert metrics.get("obs.forecast.snapshot.loads") == 1
+
+
+def test_torn_snapshot_is_audible_and_falls_back_cold(tmp_path):
+    path = str(tmp_path / "journal.forecast.json")
+    model = forecast.ForecastModel()
+    model.observe("c", 50, 0.01, M=40, N=40)
+    assert model.save(path)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])        # torn write
+    warm = forecast.ForecastModel()
+    assert warm.load(path) is False
+    assert metrics.get("obs.forecast.snapshot.torn") == 1
+    assert warm.predict("c", M=40, N=40).cold is True
+    # a flipped byte (CRC mismatch, valid JSON) is equally audible
+    sealed = json.loads(raw)
+    sealed["crc32"] = (sealed["crc32"] + 1) % (1 << 32)
+    open(path, "w").write(json.dumps(sealed))
+    assert forecast.ForecastModel().load(path) is False
+    assert metrics.get("obs.forecast.snapshot.torn") == 2
+
+
+def test_missing_snapshot_is_silent(tmp_path):
+    model = forecast.ForecastModel()
+    assert model.load(str(tmp_path / "absent.json")) is False
+    assert metrics.get("obs.forecast.snapshot.torn") == 0
+
+
+# -- the history tap's byte-identity contract ---------------------------
+
+
+def test_history_flag_off_program_is_byte_identical_to_ledger():
+    """``history_every=0`` must lower to the committed flag-off
+    executable bit-for-bit, and ``history_every=5`` must match ITS
+    committed opt-in entry (callbacks legal there, still no
+    collectives) — the ledger pins both sides of the seam."""
+    from poisson_tpu.contracts.hlo import find_forbidden, hlo_fingerprint
+    from poisson_tpu.contracts.manifest import (_problem, _setup,
+                                                load_ledger, markers_for)
+    from poisson_tpu.solvers.pcg import _solve
+
+    entries = load_ledger()["entries"]
+    a, b, rhs, aux = _setup("float64", False)
+    off = _solve.lower(_problem(), False, 0, 0, 0.0, False, 0,
+                       a, b, rhs, aux).as_text()
+    assert not find_forbidden(off, markers_for(("callbacks",)))
+    assert hlo_fingerprint(off) == \
+        entries["solve.jacobi_f64"]["fingerprint"]
+    on = _solve.lower(_problem(), False, 0, 0, 0.0, False, 5,
+                      a, b, rhs, aux).as_text()
+    assert find_forbidden(on, markers_for(("callbacks",)))
+    assert not find_forbidden(on, markers_for(("collectives", "mg")))
+    assert hlo_fingerprint(on) == \
+        entries["solve.history_f64"]["fingerprint"]
+
+
+def test_history_tap_does_not_change_convergence():
+    """Golden-count pin: the residual-history callback observes, never
+    perturbs — iterations, final diff, and the solution field are
+    bit-for-bit across history off/on, and the tap captured exactly
+    the k % 5 == 0 boundaries."""
+    from poisson_tpu.solvers.pcg import pcg_solve
+
+    base = pcg_solve(P40, dtype="float64", scaled=False)
+    buf = forecast.HistoryBuffer()
+    forecast.set_history(buf)
+    tapped = pcg_solve(P40, dtype="float64", scaled=False,
+                       history_every=5)
+    forecast.set_history(None)
+    assert tapped.iterations == base.iterations
+    assert float(tapped.diff) == float(base.diff)
+    np.testing.assert_array_equal(np.asarray(tapped.w),
+                                  np.asarray(base.w))
+    ks = [k for k, _ in buf.samples]
+    assert ks and all(k % 5 == 0 for k in ks)
+    assert buf.slope() is not None and buf.slope() < 0
+
+
+def test_history_rejects_the_mg_path():
+    from poisson_tpu.mg.hierarchy import MGConfig
+    from poisson_tpu.solvers.pcg import pcg_solve
+
+    with pytest.raises(ValueError, match="history_every"):
+        pcg_solve(P40, preconditioner="mg", mg_config=MGConfig(),
+                  history_every=5)
+
+
+# -- predicted-deadline admission (both engines) ------------------------
+
+
+@pytest.mark.parametrize("scheduling", ["drain", SCHED_CONTINUOUS])
+def test_doomed_deadline_sheds_typed_with_zero_compute(scheduling):
+    """The acceptance criterion: after the cohort calibrates, a
+    deadline the model prices as hopeless is refused AT ADMISSION —
+    typed ``shed[predicted_deadline]``, no dispatch, no iterations —
+    and the ledger still closes (nothing lost), under both engines."""
+    svc, _ = _service(ServicePolicy(
+        capacity=16, scheduling=scheduling,
+        degradation=_quiet_degradation(),
+        forecast=ForecastPolicy()))
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=f"warm-{i}", problem=P40))
+    warm = svc.drain()
+    assert all(o.converged for o in warm)
+    doomed = svc.submit(SolveRequest(request_id="doomed", problem=P40,
+                                     deadline_seconds=1e-9))
+    assert doomed is not None and doomed.kind == OUTCOME_SHED
+    assert doomed.shed_reason == SHED_PREDICTED_DEADLINE
+    d = doomed.decomposition or {}
+    assert d.get("compute_s", 1) == 0
+    assert d.get("dispatches", 1) == 0
+    assert d.get("iterations", 1) == 0
+    assert metrics.get("serve.shed.predicted_deadline") == 1
+    assert metrics.get("serve.forecast.admission_checks") == 1
+    stats = svc.stats()
+    assert stats["lost"] == 0 and stats["pending"] == 0
+
+
+def test_feasible_deadline_still_admits_on_a_warm_cohort():
+    svc, _ = _service(ServicePolicy(
+        capacity=16, degradation=_quiet_degradation(),
+        forecast=ForecastPolicy()))
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=f"warm-{i}", problem=P40))
+    svc.drain()
+    assert svc.submit(SolveRequest(request_id="ok", problem=P40,
+                                   deadline_seconds=3600.0)) is None
+    (out,) = svc.drain()
+    assert out.converged and out.request_id == "ok"
+    assert metrics.get("serve.shed.predicted_deadline") == 0
+
+
+def test_no_deadline_request_is_never_admission_checked():
+    svc, _ = _service(ServicePolicy(
+        capacity=16, degradation=_quiet_degradation(),
+        forecast=ForecastPolicy()))
+    svc.submit(SolveRequest(request_id="free", problem=P40))
+    svc.drain()
+    assert metrics.get("serve.forecast.admission_checks") == 0
+
+
+def test_forecast_off_by_default_no_observatory_traffic():
+    assert ServicePolicy().forecast is None
+    svc, _ = _service(ServicePolicy(capacity=16))
+    svc.submit(SolveRequest(request_id="r", problem=P40,
+                            deadline_seconds=1e-9))
+    svc.drain()
+    assert metrics.get("obs.forecast.predictions") == 0
+    assert metrics.get("serve.forecast.admission_checks") == 0
+
+
+def test_forecast_policy_defaults():
+    fp = ForecastPolicy()
+    assert fp.admission_shed and fp.reforecast
+    assert not fp.backlog_degradation
+    assert fp.margin == 1.0 and fp.history_every == 0
+
+
+# -- lane-boundary re-forecast preemption -------------------------------
+
+
+def test_reforecast_preempts_a_doomed_lane_occupant():
+    """Admission let an optimistic deadline through
+    (``admission_shed=False``); the continuous engine's lane-boundary
+    re-forecast — fit to the request's OWN residual history — prices
+    the remaining work above the deadline budget (margin inflated to
+    force the verdict deterministically) and pre-empts mid-flight:
+    a typed predicted-deadline shed plus ``serve.forecast.preempted``,
+    with the breaker never blamed."""
+    svc, _ = _service(ServicePolicy(
+        capacity=8, scheduling=SCHED_CONTINUOUS, refill_chunk=10,
+        degradation=_quiet_degradation(),
+        forecast=ForecastPolicy(admission_shed=False, reforecast=True,
+                                margin=1e6)))
+    svc.submit(SolveRequest(request_id="victim", problem=P40,
+                            deadline_seconds=5.0))
+    (out,) = svc.drain()
+    assert out.kind == OUTCOME_SHED
+    assert out.shed_reason == SHED_PREDICTED_DEADLINE
+    assert metrics.get("serve.forecast.preempted") == 1
+    assert svc.stats()["lost"] == 0
+
+
+def test_reforecast_never_preempts_without_a_fitted_slope():
+    """One lane boundary = one history point = no slope: the re-forecast
+    must decline to guess, and the request runs to convergence (margin
+    would otherwise doom it instantly)."""
+    svc, _ = _service(ServicePolicy(
+        capacity=8, scheduling=SCHED_CONTINUOUS, refill_chunk=100,
+        degradation=_quiet_degradation(),
+        forecast=ForecastPolicy(admission_shed=False, reforecast=True,
+                                margin=1e6)))
+    svc.submit(SolveRequest(request_id="r", problem=P40,
+                            deadline_seconds=5.0))
+    (out,) = svc.drain()
+    assert out.converged
+    assert metrics.get("serve.forecast.preempted") == 0
+
+
+# -- ETA backlog degradation --------------------------------------------
+
+
+def test_backlog_degradation_rung_fires_and_is_counted():
+    svc, _ = _service(ServicePolicy(
+        capacity=32, degradation=_quiet_degradation(),
+        forecast=ForecastPolicy(backlog_degradation=True,
+                                backlog_objective_seconds=1e-9)))
+    for i in range(6):
+        svc.submit(SolveRequest(request_id=i, problem=P40))
+    outs = svc.drain()
+    assert len(outs) == 6 and svc.stats()["lost"] == 0
+    assert metrics.get("serve.degraded.backlog_driven") >= 1
+
+
+def test_backlog_gauge_published():
+    svc, _ = _service(ServicePolicy(
+        capacity=16, degradation=_quiet_degradation(),
+        forecast=ForecastPolicy()))
+    svc.submit(SolveRequest(request_id="r", problem=P40))
+    svc.drain()
+    snap = metrics.snapshot()
+    assert "serve.forecast.backlog_seconds" in snap["gauges"]
+
+
+# -- calibration --------------------------------------------------------
+
+
+def test_calibration_error_bounded_on_repeat_traffic():
+    """The ≤25% p50 acceptance bound: on a warm repeating cohort the
+    forecaster's median absolute iteration error collapses (identical
+    problems iterate identically)."""
+    svc, _ = _service(ServicePolicy(
+        capacity=32, degradation=_quiet_degradation(),
+        forecast=ForecastPolicy()))
+    for i in range(6):
+        svc.submit(SolveRequest(request_id=i, problem=P40))
+    svc.drain()
+    err = svc._forecast.calibration_err_pct()
+    assert err is not None and err <= 25.0
+    assert metrics.get("obs.forecast.predictions") >= 6
+    assert metrics.get("obs.forecast.cold_cohorts") == 1
+
+
+def test_session_snapshot_warm_loads_on_recover(tmp_path):
+    """Journal-attached services persist the model beside the journal
+    and a recovered service loads it: the first post-crash prediction
+    is already calibrated (no cold re-seeding across restarts)."""
+    jpath = str(tmp_path / "serve.journal")
+    policy = ServicePolicy(capacity=16,
+                           degradation=_quiet_degradation(),
+                           forecast=ForecastPolicy())
+    vc0 = VirtualClock()
+    svc = SolveService(policy, clock=vc0, sleep=vc0.sleep,
+                       journal=SolveJournal(jpath, clock=vc0))
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=f"w{i}", problem=P40))
+    svc.drain()
+    assert os.path.exists(forecast.snapshot_path(jpath))
+    vc = VirtualClock()
+    revived = SolveService.recover(SolveJournal(jpath, clock=vc),
+                                   policy, clock=vc, sleep=vc.sleep)
+    fc = revived._forecast.predict(
+        svc._cohort(SolveRequest(request_id="x", problem=P40)),
+        **svc._forecast_args(SolveRequest(request_id="x", problem=P40)))
+    assert fc.cold is False and fc.samples >= 3
+
+
+# -- flight-recorder annotation (satellite: per-member dk attrs) --------
+
+
+def test_annotate_rides_the_open_span(tmp_path):
+    from poisson_tpu import obs
+    from poisson_tpu.obs.trace import load_events
+
+    obs.configure(trace_dir=str(tmp_path))
+    vc = VirtualClock()
+    fr = flight.FlightRecorder(clock=vc)
+    fr.admit("r")
+    fr.begin("r", flight.SPAN_RESIDENT)
+    fr.annotate("r", flight.SPAN_RESIDENT, dk=12, k=24)
+    fr.annotate("r", flight.SPAN_RESIDENT, k=36)     # later values win
+    fr.annotate("r", "not_open", x=1)                # silent no-op
+    vc.advance(0.5)
+    fr.end("r", flight.SPAN_RESIDENT)
+    obs.finalize()
+    (span,) = [e for e in load_events(str(tmp_path))
+               if e.get("name") == "flight.span"]
+    assert span["attrs"]["dk"] == 12 and span["attrs"]["k"] == 36
+
+
+# -- regression-sentinel & chaos pins -----------------------------------
+
+
+def test_calibration_metric_pinned_lower_is_better():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "benchmarks"))
+    try:
+        import regress
+    finally:
+        sys.path.pop(0)
+    assert "obs.forecast.calibration_err_pct" in regress._METRICS
+    assert "obs.forecast.calibration_err_pct" in regress._LOWER_IS_BETTER
+    rec = {"metric": "serve.p99_latency", "value": 0.5,
+           "detail": {"grid": [40, 40], "dtype": "float32",
+                      "platform": "cpu", "backend": "xla_serve",
+                      "devices": 1,
+                      "forecast_calibration_err_pct": 3.2}}
+    recs = regress.records_from_result(rec, "r.json")
+    assert [r["metric"] for r in recs] == \
+        ["serve.p99_latency", "obs.forecast.calibration_err_pct"]
+    assert regress.cohort_key(recs[0]) != regress.cohort_key(recs[1])
+    del rec["detail"]["forecast_calibration_err_pct"]
+    assert len(regress.records_from_result(rec, "r.json")) == 1
+
+
+def test_chaos_scenario_registered_and_green():
+    from poisson_tpu.testing import chaos
+
+    assert "forecast-predicted-shed" in chaos.scenario_names()
+    report = chaos.run_scenario("forecast-predicted-shed", seed=0)
+    assert report["ok"], report["checks"]
+    assert report["checks"]["zero_compute_burned"]
+    assert report["checks"]["feasible_twin_still_served"]
+
+
+# -- the scoreboard -----------------------------------------------------
+
+
+def _run_some_forecast_traffic():
+    svc, _ = _service(ServicePolicy(
+        capacity=16, degradation=_quiet_degradation(),
+        forecast=ForecastPolicy()))
+    for i in range(3):
+        svc.submit(SolveRequest(request_id=i, problem=P40))
+    svc.drain()
+    svc.submit(SolveRequest(request_id="doomed", problem=P40,
+                            deadline_seconds=1e-9))
+
+
+def test_scoreboard_agrees_across_both_sources():
+    """The same numbers whether read from a live registry snapshot or
+    round-tripped through the Prometheus exposition — the scoreboard
+    must not depend on which side of the wire it runs."""
+    from poisson_tpu.obs import export
+
+    _run_some_forecast_traffic()
+    snap = metrics.snapshot()
+    live = forecast.build_scoreboard(snap)
+    wire = forecast.build_scoreboard(export.parse_text(
+        export.render(snap)))
+    assert live["forecast"] == wire["forecast"]
+    assert live["queue"] == wire["queue"]
+    assert live["forecast"]["predictions"] >= 3
+    assert live["forecast"]["predicted_deadline_sheds"] == 1
+    text = forecast.render_scoreboard(live)
+    assert "forecast" in text and "p50_err" in text
+
+
+def test_top_cli_post_mortem_metrics_dir(tmp_path, capsys):
+    from poisson_tpu.cli import _main_top
+
+    _run_some_forecast_traffic()
+    (tmp_path / "metrics-rank0.json").write_text(
+        json.dumps(metrics.snapshot(rank=0)))
+    rc = _main_top(["--metrics-dir", str(tmp_path), "--json"])
+    assert rc == 0
+    board = json.loads(capsys.readouterr().out)
+    assert board["forecast"]["predictions"] >= 3
+    assert board["forecast"]["predicted_deadline_sheds"] == 1
+
+
+def test_top_cli_source_validation(tmp_path, capsys):
+    from poisson_tpu.cli import _main_top
+
+    assert _main_top(["--json"]) == 2                  # no source
+    assert _main_top(["--metrics-dir", str(tmp_path), "--textfile",
+                      str(tmp_path / "x.prom"), "--json"]) == 2
+    capsys.readouterr()
+    assert _main_top(["--textfile", str(tmp_path / "absent.prom"),
+                      "--json"]) == 1                  # unreadable
+
+
+def test_top_cli_textfile_source(tmp_path, capsys):
+    from poisson_tpu.cli import _main_top
+    from poisson_tpu.obs import export
+
+    _run_some_forecast_traffic()
+    path = tmp_path / "metrics.prom"
+    export.write_textfile(str(path))
+    rc = _main_top(["--textfile", str(path), "--json"])
+    assert rc == 0
+    board = json.loads(capsys.readouterr().out)
+    assert board["forecast"]["predicted_deadline_sheds"] == 1

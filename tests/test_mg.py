@@ -112,7 +112,8 @@ def test_default_jacobi_path_hlo_byte_identical():
     a, b, rhs, aux = host_setup(p, "float64", False)
 
     current_txt = pcg_mod._solve.lower(
-        p, False, 0, 0, 0.0, False, a, b, rhs, aux).compile().as_text()
+        p, False, 0, 0, 0.0, False, 0,
+        a, b, rhs, aux).compile().as_text()
 
     # Named ``_solve`` so both lowerings produce the same HLO module
     # name ("jit__solve") and with it identical instruction numbering.
