@@ -1223,8 +1223,43 @@ def _serve_slowest(svc, n: int = 3):
     return slowest_requests(svc.outcomes(), n)
 
 
+def _router_policy(enabled: bool, platform: str):
+    """The serve benches' RouterPolicy: on non-TPU hosts the Pallas
+    arms are force-listed (``assume_available``) so the routing state
+    machine — cold analytic picks, measured grading, misprediction
+    sentinels — exercises for real; the execution gate still runs
+    every dispatch on the proven xla path, so the record's latencies
+    are unchanged by routing."""
+    if not enabled:
+        return None
+    from poisson_tpu.serve import RouterPolicy
+
+    assume = (() if platform == "tpu"
+              else ("pallas_resident", "pallas_ca"))
+    return RouterPolicy(assume_available=assume)
+
+
+def _router_detail(svc):
+    """Router decision/sentinel summary for the bench record —
+    decisions, mispredictions, demotions, per-backend measured
+    roofline fractions, and the roofline calibration error.
+    Attribution-only (catalogued in contracts ATTRIBUTION_ONLY_DETAIL):
+    regress.py cohorts on ``routed_backend``, not on this payload."""
+    router = getattr(svc, "_router", None)
+    if router is None:
+        return None
+    detail = router.stats()
+    roofline = getattr(svc, "_roofline", None)
+    if roofline is not None:
+        err = roofline.calibration_err_pct()
+        detail["roofline_calibration_err_pct"] = (
+            None if err is None else round(err, 2))
+    return detail
+
+
 def _serve_openloop_bench(problem, requests: int, rate: float, devices,
-                          platform: str, downgraded: bool = False) -> int:
+                          platform: str, downgraded: bool = False,
+                          router: bool = False) -> int:
     """Open-loop service mode: Poisson arrivals at ``rate`` requests/sec
     (``--serve R --arrival-rate L``), measured twice over the SAME seeded
     schedule — once under the PR 5 batch-drain engine, once under the
@@ -1266,6 +1301,7 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
             retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
                               backoff_cap=0.1),
             forecast=ForecastPolicy(),
+            router=_router_policy(router, platform),
         )
 
     def run(mode):
@@ -1330,6 +1366,12 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
             "warmup_seconds": round(warm_seconds, 2),
             "forecast_calibration_err_pct":
                 _forecast_calibration(cont_svc),
+            # Router attribution (continuous arm): the decision mix,
+            # sentinel activity, and measured roofline fractions.
+            # routed_backend is a COHORT discriminator (regress.py):
+            # auto-routed runs never judge hand-picked baselines.
+            "router": _router_detail(cont_svc),
+            "routed_backend": "auto" if router else "off",
             "dtype": "float32",
             "backend": "xla_serve",
             "devices": 1,
@@ -1556,7 +1598,7 @@ def _serve_fleet_bench(problem, requests: int, workers: int,
 
 
 def _serve_bench(problem, requests: int, devices, platform: str,
-                 downgraded: bool = False) -> int:
+                 downgraded: bool = False, router: bool = False) -> int:
     """Service mode: throughput and latency percentiles under fault load.
 
     Drives the solve service (``poisson_tpu.serve``) with a request load
@@ -1588,6 +1630,7 @@ def _serve_bench(problem, requests: int, devices, platform: str,
         retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
                           backoff_cap=0.1),
         forecast=ForecastPolicy(),
+        router=_router_policy(router, platform),
     )
 
     def build():
@@ -1654,6 +1697,8 @@ def _serve_bench(problem, requests: int, devices, platform: str,
             "p99_exemplar": _serve_p99_exemplar(svc),
             "slowest_requests": _serve_slowest(svc),
             "forecast_calibration_err_pct": _forecast_calibration(svc),
+            "router": _router_detail(svc),
+            "routed_backend": "auto" if router else "off",
             "throughput_rps": round(stats["completed"] / wall, 2),
             "wall_seconds": round(wall, 4),
             "first_run_seconds": round(first_run, 2),
@@ -2221,6 +2266,20 @@ def main() -> int:
             print(f"--repeat-fingerprint must be >= 1, got "
                   f"{repeat_fingerprint}", file=sys.stderr)
             return 2
+    serve_router = False
+    if "--router" in argv:
+        i = argv.index("--router")
+        argv = argv[:i] + argv[i + 1:]
+        if serve_requests is None:
+            print("--router is a --serve mode option", file=sys.stderr)
+            return 2
+        if (serve_workers is not None or geometry_mix is not None
+                or repeat_fingerprint is not None):
+            print("--router rides the plain and open-loop serve modes; "
+                  "drop --workers/--geometry-mix/--repeat-fingerprint",
+                  file=sys.stderr)
+            return 2
+        serve_router = True
     if batch is not None and serve_requests is not None:
         print("--batch and --serve are separate bench modes; pick one",
               file=sys.stderr)
@@ -2325,9 +2384,10 @@ def main() -> int:
         if arrival_rate is not None:
             return _serve_openloop_bench(problem, serve_requests,
                                          arrival_rate, devices, platform,
-                                         downgraded=downgraded)
+                                         downgraded=downgraded,
+                                         router=serve_router)
         return _serve_bench(problem, serve_requests, devices, platform,
-                            downgraded=downgraded)
+                            downgraded=downgraded, router=serve_router)
 
     def xla_run(gate=None):
         if len(devices) > 1:

@@ -122,6 +122,7 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                repeat_fingerprint: Optional[int] = None,
                session: Optional[bool] = None,
                warm_start: Optional[bool] = None,
+               routed_backend: Optional[str] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -188,6 +189,13 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # metric's own (steps/sec alarms on a DROP, like MLUPS).
         "session": session,
         "warm_start": warm_start,
+        # Router records (bench.py --serve --router): the routing mode
+        # is experiment identity — an auto-routed run's cohorts, sticky
+        # executables, and sentinel baselines form per routed backend,
+        # so it never judges (or hides behind) a hand-picked baseline.
+        # "off" (the stamped default) and None (pre-router artifacts)
+        # normalize to the same cohort: old baselines stay comparable.
+        "routed_backend": routed_backend or "off",
         "failed": bool(failed),
         "note": note,
     }
@@ -231,6 +239,7 @@ def record_from_result(result: dict, source: str,
         repeat_fingerprint=det.get("repeat_fingerprint"),
         session=det.get("session"),
         warm_start=det.get("warm_start"),
+        routed_backend=det.get("routed_backend"),
     )
 
 
@@ -359,7 +368,8 @@ def cohort_key(rec: dict):
             rec.get("preconditioner"), rec.get("device_topology"),
             rec.get("krylov_mode"), rec.get("deflation"),
             rec.get("repeat_fingerprint"),
-            rec.get("session"), rec.get("warm_start"))
+            rec.get("session"), rec.get("warm_start"),
+            rec.get("routed_backend") or "off")
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

@@ -506,6 +506,88 @@ def telemetry_report(tdir: pathlib.Path) -> int:
               f"torn-snapshot event(s) (each audible, model falls back "
               f"to cold seeds).")
 
+    # Backend router (serve.router + obs.roofline): the decision mix,
+    # per-arm measured-vs-model roofline fractions, and every sentinel
+    # action (misprediction → demotion → half-open → recovery) as a
+    # timeline of typed events.
+    router_counters = {
+        name: val for name, val in counters.items()
+        if name.startswith("serve.router.")
+        or name == "serve.degraded.backend_downshift"}
+    roofline_gauges: dict = {}
+    for _rank in sorted(gauges_by_rank):
+        for name, val in (gauges_by_rank[_rank] or {}).items():
+            # calibration_pct is a histogram dict — the scalar gauges
+            # are the readable summary; skip non-numerics.
+            if (name.startswith("obs.roofline.")
+                    and isinstance(val, (int, float))):
+                roofline_gauges.setdefault(name, val)
+    router_events = [e for e in events if e.get("kind") == "event"
+                     and str(e.get("name", "")).startswith(
+                         "serve.router.")]
+    if router_counters or roofline_gauges:
+        print("\n## Backend router\n")
+        merged = dict(router_counters)
+        merged.update(roofline_gauges)
+        print("| router metric | value |")
+        print("|---|---|")
+        for name in sorted(merged):
+            val = merged[name]
+            shown = (f"{val:.4f}" if isinstance(val, float)
+                     and val != int(val) else str(int(val)))
+            print(f"| {name} | {shown} |")
+        decisions = router_counters.get("serve.router.decisions", 0)
+        cold = router_counters.get("serve.router.cold_decisions", 0)
+        warm = router_counters.get("serve.router.warm_decisions", 0)
+        chosen = {name[len("serve.router.chosen."):]: val
+                  for name, val in router_counters.items()
+                  if name.startswith("serve.router.chosen.")}
+        if chosen:
+            # The decision table: per-arm picks next to their measured
+            # roofline evidence (running p50 fraction of peak) — the
+            # measured-vs-model comparison the router graduates on.
+            print("\n| backend arm | decisions | measured p50 "
+                  "fraction of peak |")
+            print("|---|---|---|")
+            for arm in sorted(chosen):
+                frac = roofline_gauges.get(
+                    f"obs.roofline.fraction.{arm}")
+                print(f"| {arm} | {int(chosen[arm])} | "
+                      f"{_fmt(frac) if frac is not None else '-'} |")
+        calib = roofline_gauges.get("obs.roofline.calibration_err_pct")
+        calib_txt = (f"p50 measured-vs-model fraction error "
+                     f"{calib:.1f}%" if calib is not None
+                     else "no measured observations yet")
+        print(f"\n{int(decisions)} routing decision(s) "
+              f"({int(cold)} cold from the analytic table, {int(warm)} "
+              f"warm from measured evidence) across "
+              f"{max(1, len(chosen))} arm(s); {calib_txt}; "
+              f"{int(router_counters.get('serve.router.mispredictions', 0))} "
+              f"misprediction(s) → "
+              f"{int(router_counters.get('serve.router.demotions', 0))} "
+              f"demotion(s), "
+              f"{int(router_counters.get('serve.router.recoveries', 0))} "
+              f"half-open recovery(ies); "
+              f"{int(router_counters.get('serve.degraded.backend_downshift', 0))} "
+              f"backend-downshift rung engagement(s).")
+        sentinel = [e for e in router_events
+                    if e.get("name") in ("serve.router.misprediction",
+                                         "serve.router.demote",
+                                         "serve.router.half_open",
+                                         "serve.router.recover")]
+        for e in sentinel[:20]:
+            attrs = e.get("attrs") if isinstance(e.get("attrs"), dict) \
+                else e
+            name = str(e.get("name"))[len("serve.router."):]
+            line = (f"- {name}: {attrs.get('backend')} on device "
+                    f"{attrs.get('device')}")
+            if e.get("name") == "serve.router.misprediction":
+                line += (f" — measured fraction "
+                         f"{attrs.get('fraction')} vs expected "
+                         f"{attrs.get('expected')} (threshold "
+                         f"{attrs.get('threshold')})")
+            print(line)
+
     # Flight recorder (obs.flight): per-request causal traces and their
     # latency decompositions — render the aggregate view plus ONE
     # request's end-to-end timeline (the slowest, the request a p99

@@ -252,6 +252,26 @@ def _build_session_heat_warm() -> str:
                                 m, u, u).as_text()
 
 
+def _build_serve_routed_default() -> str:
+    from poisson_tpu.serve.router import executor_backend
+    from poisson_tpu.solvers.pcg import _solve
+
+    # The router is an OBSERVATION-plane chooser: whatever arm it
+    # names, execution runs through the xla executor gate until a
+    # future PR lands real pallas dispatch. If that gate ever opens,
+    # this program is no longer the flags-off lowering and the pin
+    # below must be revisited deliberately, not silently.
+    for arm in ("xla", "pallas_resident", "pallas_ca"):
+        if executor_backend(arm) != "xla":
+            raise AssertionError(
+                f"executor_backend({arm!r}) no longer gates to xla — "
+                "the routed default program is not the flags-off "
+                "lowering any more")
+    a, b, rhs, aux = _setup("float64", False)
+    return _solve.lower(_problem(), False, 0, 0, 0.0, False, 0,
+                        a, b, rhs, aux).as_text()
+
+
 _ALL_OFF = ("callbacks", "collectives", "mg")
 
 PROGRAMS: Tuple[ProgramSpec, ...] = (
@@ -355,6 +375,18 @@ PROGRAMS: Tuple[ProgramSpec, ...] = (
                     "program of a converging transient stream",
         forbid=_ALL_OFF,
         build=_build_session_heat_warm,
+    ),
+    ProgramSpec(
+        name="serve.routed_default_f64",
+        description="the program a router-enabled service actually "
+                    "executes on the default path: every routed arm "
+                    "gates through the xla executor, so the lowering "
+                    "must stay byte-identical to the historical "
+                    "flags-off executable (fingerprint equals "
+                    "solve.jacobi_f64) — the router may only ever "
+                    "change attribution, never numerics",
+        forbid=_ALL_OFF,
+        build=_build_serve_routed_default,
     ),
 )
 
@@ -596,6 +628,11 @@ ATTRIBUTION_ONLY_DETAIL = {
     "steps": "run length; the per-step rate is the record's value",
     "session_ab": "both-arm A/B payload (cohort key carries "
                   "detail.session and detail.warm_start)",
+    # backend-router attribution (cohort split rides on
+    # detail.routed_backend, which regress.py lifts into the key)
+    "router": "decision-mix / sentinel / measured-fraction / roofline-"
+              "calibration snapshot; detail.routed_backend is the "
+              "cohort discriminator regress.py lifts",
     # serve-mode latency/throughput payload beside the record's value
     "p95_seconds": "latency payload",
     "shed_rate": "outcome-rate payload (its own gauge exists)",

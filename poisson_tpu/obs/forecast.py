@@ -566,6 +566,23 @@ def build_scoreboard(metrics: dict) -> dict:
             _get(flat, "serve.shed.predicted_deadline"),
         "preempted": _get(flat, "serve.forecast.preempted"),
     }
+    backends = {
+        "decisions": _get(flat, "serve.router.decisions"),
+        "cold_decisions": _get(flat, "serve.router.cold_decisions"),
+        "warm_decisions": _get(flat, "serve.router.warm_decisions"),
+        "mispredictions": _get(flat, "serve.router.mispredictions"),
+        "demotions": _get(flat, "serve.router.demotions"),
+        "recoveries": _get(flat, "serve.router.recoveries"),
+        "demoted_arms": _get(flat, "serve.router.demoted_arms"),
+        # Per-arm decision counts and per-backend measured roofline
+        # fractions (running p50) — the scan keys are the backend
+        # names, so the pane reads identically from a live snapshot, a
+        # parsed Prometheus page, and a dead metrics dir.
+        "chosen": _prefix_scan(flat, "serve.router.chosen"),
+        "fractions": _prefix_scan(flat, "obs.roofline.fraction"),
+        "calibration_err_pct":
+            _get(flat, "obs.roofline.calibration_err_pct"),
+    }
     return {
         "queue": queue,
         "lanes": lanes,
@@ -574,6 +591,7 @@ def build_scoreboard(metrics: dict) -> dict:
         "caches": caches,
         "placement": placement,
         "forecast": forecast,
+        "backends": backends,
     }
 
 
@@ -593,6 +611,10 @@ def render_scoreboard(board: dict) -> str:
     q, ln = board["queue"], board["lanes"]
     br, slo = board["breakers"], board["slo"]
     ca, pl, fc = board["caches"], board["placement"], board["forecast"]
+    # Older snapshots (pre-router) have no backends section: render the
+    # pane with every cell dark rather than crashing on a dead
+    # process's artifacts.
+    bk = board.get("backends") or {}
     lines = [
         "poisson_tpu fleet scoreboard",
         "=" * 64,
@@ -623,5 +645,18 @@ def render_scoreboard(board: dict) -> str:
          f"  p50_err {_cell(fc['calibration_err_pct'], '{:.1f}')}%"
          f"  pred_sheds {_cell(fc['predicted_deadline_sheds'])}"
          f"  preempted {_cell(fc['preempted'])}"),
+        (f"backends  decisions {_cell(bk.get('decisions'))}"
+         f" (cold {_cell(bk.get('cold_decisions'))}"
+         f"/warm {_cell(bk.get('warm_decisions'))})"
+         f"  mispred {_cell(bk.get('mispredictions'))}"
+         f"  demoted {_cell(bk.get('demotions'))}"
+         f"  recovered {_cell(bk.get('recoveries'))}"
+         f"  p50_err {_cell(bk.get('calibration_err_pct'), '{:.1f}')}%"
+         + "".join(
+             f"  {arm} n={_cell(n)}"
+             + (f" frac={_cell((bk.get('fractions') or {}).get(arm), '{:.3f}')}"
+                if (bk.get("fractions") or {}).get(arm) is not None
+                else "")
+             for arm, n in sorted((bk.get("chosen") or {}).items()))),
     ]
     return "\n".join(lines)

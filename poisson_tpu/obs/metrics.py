@@ -402,6 +402,52 @@ counters and numeric gauges in Prometheus text format):
   rungs chosen because ETA backlog, not raw depth, crossed the
   fraction — the forecast-aware sibling of
   ``serve.degraded.slo_driven``).
+
+- the ``obs.roofline`` family — the roofline observatory
+  (:mod:`poisson_tpu.obs.roofline`): counters
+  ``obs.roofline.observations`` (measured dispatches and lane
+  chunk-steps graded — achieved GB/s from the backend's effective-pass
+  model over the measured wall, as a fraction of the platform
+  bandwidth ceiling), ``obs.roofline.cold_cohorts`` (gradings against
+  the analytic prior because the cohort had no measured samples yet),
+  ``obs.roofline.skipped`` (unmeasurable dispatches — zero measured
+  wall or zero iterations; a VirtualClock drill that never advances
+  time produces only these, deliberately),
+  ``obs.roofline.snapshot.{saves,loads,torn,write_errors}`` (the
+  CRC-sealed journal-adjacent profile snapshot, same save/load/torn
+  contract as ``obs.forecast.snapshot.*``). Gauges:
+  ``obs.roofline.fraction`` (the most recent measured fraction of
+  peak), ``obs.roofline.fraction.*`` (running p50 measured fraction
+  per backend — the scalar the ``top`` Backends pane and the router's
+  warm evidence read), ``obs.roofline.abs_err_pct`` (the most recent
+  grading's |expected − measured| fraction error, percent of
+  expected), ``obs.roofline.calibration_err_pct`` (the running p50 of
+  those errors — the calibration figure ``bench.py --serve`` stamps),
+  and ``obs.roofline.calibration_pct`` (a real histogram of per-
+  observation percent errors, rendered as a Prometheus histogram).
+
+- the ``serve.router`` family — the cost-model backend router
+  (:mod:`poisson_tpu.serve.router`, ``ServicePolicy.router``):
+  ``serve.router.decisions`` (dispatches routed) split into
+  ``serve.router.{cold_decisions,warm_decisions}`` (cold = the
+  analytic policy table — VMEM-resident small grids, CA on the HBM
+  plateau, xla elsewhere; warm = ranked by measured per-cohort
+  roofline evidence) with per-arm ``serve.router.chosen.*``;
+  ``serve.router.mispredictions`` (measured dispatches landing below
+  ``misprediction_fraction`` × the cohort's expected fraction — each
+  also emits a typed ``serve.router.misprediction`` event);
+  ``serve.router.demotions`` (arms benched after ``demote_after``
+  consecutive mispredictions, breaker-style),
+  ``serve.router.half_opens`` (benched arms re-probed after cooldown)
+  and ``serve.router.recoveries`` (probes that measured healthy and
+  closed the arm); ``serve.router.executor_fallbacks`` (routed
+  non-xla choices executed on the proven xla path — the execution
+  gate that holds until the Pallas kernels have a valid hardware
+  measurement, see ``serve.router.executor_backend``);
+  ``serve.degraded.backend_downshift`` (the degradation ladder's
+  backend rung: queue pressure past ``downshift_at`` forces the xla
+  floor arm). Gauge ``serve.router.demoted_arms`` — currently benched
+  (backend, device) arms.
 """
 
 from __future__ import annotations

@@ -1,0 +1,384 @@
+"""Roofline observatory: per-dispatch measured bandwidth attribution.
+
+Roofline attribution (Williams/Waterman/Patterson 2009) lived only in
+bench reports until now — ``obs.costs.roofline_summary`` prices a
+finished bench run against the platform ceiling, but production
+dispatches emitted latency without ever saying *how fast they should
+have been*. This module closes that gap with the ``obs/forecast.py``
+estimator idiom applied to throughput:
+
+1. :class:`RooflineModel` — a per-cohort streaming profile of measured
+   roofline fraction. Every serve dispatch and lane chunk-step feeds
+   one observation: measured seconds → achieved GB/s (the backend's
+   effective-pass model × grid bytes × iterations over the measured
+   wall) → fraction of the platform bandwidth ceiling
+   (``obs.costs.platform_peak_gbps``; hosts without a ceiling on file
+   fall back to the forecast module's deliberately pessimistic
+   ``DEFAULT_COLD_GBPS``). Cohorts key on the full dispatch identity —
+   (backend, grid, batch, dtype, preconditioner, verify_every,
+   device_kind) — so an MG bucket on a v5e never shares a profile with
+   a plain-CG solo on a CPU host. Each observation is graded
+   predict-then-compare against the cohort's pre-insertion expectation
+   (cold cohorts expect :data:`DEFAULT_COLD_FRACTION` of peak), so the
+   calibration gauges read exactly like the forecast model's.
+
+2. CRC-sealed persistence — the model snapshots beside the journal
+   (``<journal>.roofline.json``, same ``zlib.crc32`` sealing idiom as
+   ``serve.journal`` and the forecast snapshot) and warm-loads on
+   ``--recover``: a restarted service routes from its previous life's
+   measured evidence instead of re-entering the cold-model regime.
+   Torn snapshots are skipped audibly (``obs.roofline.snapshot.torn``),
+   never trusted, never fatal.
+
+3. The backend router (``serve.router``) consumes these profiles: the
+   per-cohort measured fraction is the evidence that graduates its
+   cold analytic picks to warm measured routing, and a dispatch
+   landing far below its cohort's expectation is the misprediction
+   sentinel that demotes the (backend, device) arm.
+
+Counter feedback per observation: ``obs.roofline.observations`` (one
+per graded measurement), ``obs.roofline.cold_cohorts`` (grading against
+the analytic prior — no measured samples yet), ``obs.roofline.skipped``
+(unmeasurable dispatches: zero measured wall, the VirtualClock case —
+deliberately NOT a sample, so chaos campaigns stay deterministic),
+``obs.roofline.abs_err_pct`` / ``obs.roofline.calibration_err_pct`` /
+``obs.roofline.calibration_pct`` (last / running-p50 / histogram of
+|expected − measured| fraction error, percent), ``obs.roofline.fraction``
+(the last measured fraction) and ``obs.roofline.fraction.<backend>``
+(per-backend running p50 — the scalar gauges the ``top`` Backends pane
+and Prometheus exposition read, since per-cohort dicts would not
+survive text exposition), plus the snapshot family
+``obs.roofline.snapshot.{saves,loads,torn,write_errors}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from poisson_tpu.obs import metrics as obs
+from poisson_tpu.obs.costs import (EFFECTIVE_PASSES, grid_points,
+                                   platform_peak_gbps)
+from poisson_tpu.obs.forecast import (DEFAULT_COLD_GBPS, SAMPLE_WINDOW,
+                                      _quantile, cohort_name)
+
+# The VMEM-resident persistent kernel (ops.pallas_resident) keeps its
+# whole working set on-chip: its HBM traffic per iteration is nearly
+# zero, which the EFFECTIVE_PASSES table honestly has no entry for. The
+# router still needs a finite cost model to rank it, so this placeholder
+# prices the residual streaming the kernel cannot avoid (boundary
+# reads + convergence scalar). It is a MODEL constant that graduates to
+# a measured per-cohort fraction the first time the kernel gate runs on
+# real hardware — see BENCH.md "Backend router" note.
+RESIDENT_EFFECTIVE_PASSES = 0.5
+
+# Cold expected roofline fraction: what a streaming stencil kernel
+# should achieve against the HBM ceiling before any measurement exists
+# for its cohort. BENCH.md's measured v5e sessions put the proven
+# backends at 0.55–0.75 of the stream ceiling; 0.6 is the middle of
+# that band. Like RESIDENT_EFFECTIVE_PASSES, this is a model constant
+# that per-cohort measurement replaces as soon as samples arrive.
+DEFAULT_COLD_FRACTION = 0.6
+
+# |expected − measured| fraction error histogram bucket bounds, in
+# absolute percent of the expectation (same shape and exposition as
+# ``obs.forecast.calibration_pct``).
+CALIBRATION_BUCKETS_PCT = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0,
+                           200.0)
+
+SNAPSHOT_VERSION = 1
+
+
+def snapshot_path(journal_path: str) -> str:
+    """The roofline snapshot lives beside the journal it serves (the
+    forecast snapshot's sibling)."""
+    return journal_path + ".roofline.json"
+
+
+def effective_passes(backend: Optional[str],
+                     preconditioner: Optional[str] = None,
+                     M: int = 0, N: int = 0,
+                     dtype_bytes: int = 8) -> Optional[float]:
+    """Effective HBM passes/iteration for a backend, with the resident
+    kernel's placeholder entry and the MG surcharge folded in (an
+    MG-preconditioned iteration moves the CG body's passes PLUS one
+    V-cycle's fine-equivalent traffic — ``obs.costs.mg_vcycle_cost`` —
+    so MG cohorts never borrow the plain-CG model)."""
+    name = backend or ""
+    if name in ("pallas_resident", "pallas-resident", "resident"):
+        passes: Optional[float] = RESIDENT_EFFECTIVE_PASSES
+    else:
+        passes = EFFECTIVE_PASSES.get(name)
+    if passes is None:
+        return None
+    if preconditioner == "mg" and M > 0 and N > 0:
+        passes += _mg_passes(M, N, dtype_bytes)
+    return passes
+
+
+_MG_PASSES_MEMO: Dict[tuple, float] = {}
+
+
+def _mg_passes(M: int, N: int, dtype_bytes: int) -> float:
+    key = (M, N, dtype_bytes)
+    if key not in _MG_PASSES_MEMO:
+        from poisson_tpu.obs.costs import mg_vcycle_cost
+
+        _MG_PASSES_MEMO[key] = float(
+            mg_vcycle_cost(M, N, dtype_bytes=dtype_bytes)
+            ["passes_fine_equivalent"])
+    return _MG_PASSES_MEMO[key]
+
+
+def roofline_cohort(backend: str, M: int, N: int, batch: int,
+                    dtype_bytes: int, preconditioner: Optional[str],
+                    verify_every: int,
+                    device_kind: Optional[str]) -> str:
+    """Canonical roofline cohort key — the full dispatch identity, in
+    the forecast module's '|'-joined spelling."""
+    return cohort_name(backend, f"{M}x{N}", batch, dtype_bytes,
+                       preconditioner, verify_every, device_kind)
+
+
+@dataclass(frozen=True)
+class RooflineSample:
+    """One graded dispatch measurement. ``fraction`` is measured
+    achieved/peak; ``expected_fraction`` is the cohort's pre-insertion
+    expectation (the analytic prior when ``cold``); ``err_pct`` is
+    |expected − measured| as a percent of the expectation."""
+
+    cohort: str
+    backend: str
+    achieved_gbps: float
+    peak_gbps: float
+    fraction: float
+    expected_fraction: float
+    err_pct: float
+    cold: bool
+    samples: int
+
+
+class _CohortStats:
+    __slots__ = ("fractions",)
+
+    def __init__(self):
+        self.fractions: deque = deque(maxlen=SAMPLE_WINDOW)
+
+
+def _seal(payload: dict) -> int:
+    """CRC32 over the canonical (sorted-key) JSON — the journal's
+    sealing idiom, so a torn snapshot is detected, not trusted."""
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return zlib.crc32(blob.encode()) & 0xFFFFFFFF
+
+
+class RooflineModel:
+    """Per-cohort streaming roofline-fraction profiles.
+
+    :meth:`expected_fraction` is PURE (no counters) — the router's
+    warm-routing score and the grading path both call it.
+    :meth:`observe` is the feedback edge: compute the measured
+    fraction, grade it against the pre-insertion expectation, publish
+    the calibration counters, then absorb the sample (insertion after
+    comparison — the model never grades itself on a sample it already
+    contains, the forecast model's discipline)."""
+
+    def __init__(self):
+        self._cohorts: Dict[str, _CohortStats] = {}
+        self._by_backend: Dict[str, deque] = {}
+        self._errs: deque = deque(maxlen=SAMPLE_WINDOW * 4)
+        from poisson_tpu.obs.flight import LatencyHistogram
+        self._calibration = LatencyHistogram(CALIBRATION_BUCKETS_PCT)
+        self._lock = threading.Lock()
+
+    # -- expectation -----------------------------------------------------
+
+    def expected_fraction(self, cohort: str) -> tuple:
+        """(expected fraction, cold, samples) for a cohort — the
+        running p50 of its measured fractions, or the analytic prior
+        when no measurement exists yet."""
+        with self._lock:
+            stats = self._cohorts.get(cohort)
+            fracs = sorted(stats.fractions) if stats else []
+        if fracs:
+            return _quantile(fracs, 0.5), False, len(fracs)
+        return DEFAULT_COLD_FRACTION, True, 0
+
+    def backend_fraction(self, backend: str) -> Optional[float]:
+        """Running p50 measured fraction across every cohort of one
+        backend, or None unmeasured — the warm-routing evidence."""
+        with self._lock:
+            fracs = sorted(self._by_backend.get(backend, ()))
+        return _quantile(fracs, 0.5) if fracs else None
+
+    # -- feedback --------------------------------------------------------
+
+    def observe(self, *, backend: str, M: int, N: int, batch: int = 1,
+                dtype_bytes: int = 8,
+                preconditioner: Optional[str] = None,
+                verify_every: int = 0,
+                device_kind: Optional[str] = None,
+                iterations: int, seconds: float, devices: int = 1,
+                passes_override: Optional[float] = None
+                ) -> Optional[RooflineSample]:
+        """Grade and absorb one measured dispatch. Returns None — and
+        counts ``obs.roofline.skipped`` — when the dispatch is
+        unmeasurable (zero wall or zero iterations: the VirtualClock
+        case, deliberately not a sample so chaos stays deterministic,
+        and the degenerate empty dispatch)."""
+        if seconds <= 0.0 or iterations <= 0:
+            obs.inc("obs.roofline.skipped")
+            return None
+        passes = (passes_override if passes_override is not None
+                  else effective_passes(backend, preconditioner, M, N,
+                                        dtype_bytes))
+        if passes is None or passes <= 0.0:
+            obs.inc("obs.roofline.skipped")
+            return None
+        peak = platform_peak_gbps(device_kind)
+        if peak is None or peak <= 0.0:
+            # No ceiling on file for this part: grade against the
+            # forecast module's pessimistic host fallback rather than
+            # dropping the measurement — fractions stay comparable
+            # WITHIN the cohort (same denominator every sample), which
+            # is all the router's evidence needs.
+            peak = DEFAULT_COLD_GBPS
+        grid_bytes = grid_points(M, N) * dtype_bytes
+        model_bytes = passes * grid_bytes * max(1, int(batch)) \
+            * int(iterations)
+        achieved = model_bytes / seconds / max(1, int(devices)) / 1e9
+        fraction = achieved / peak
+        cohort = roofline_cohort(backend, M, N, max(1, int(batch)),
+                                 dtype_bytes, preconditioner,
+                                 int(verify_every), device_kind)
+        expected, cold, samples = self.expected_fraction(cohort)
+        err_pct = abs(expected - fraction) / max(expected, 1e-12) * 100.0
+        obs.inc("obs.roofline.observations")
+        if cold:
+            obs.inc("obs.roofline.cold_cohorts")
+        obs.gauge("obs.roofline.fraction", round(fraction, 6))
+        obs.gauge("obs.roofline.abs_err_pct", round(err_pct, 3))
+        with self._lock:
+            self._calibration.observe(err_pct)
+            self._errs.append(err_pct)
+            p50_err = _quantile(sorted(self._errs), 0.5)
+            obs.gauge("obs.roofline.calibration_pct",
+                      self._calibration.snapshot())
+            obs.gauge("obs.roofline.calibration_err_pct",
+                      round(p50_err, 3))
+            stats = self._cohorts.setdefault(cohort, _CohortStats())
+            stats.fractions.append(fraction)
+            per_backend = self._by_backend.setdefault(
+                backend, deque(maxlen=SAMPLE_WINDOW))
+            per_backend.append(fraction)
+            obs.gauge(f"obs.roofline.fraction.{backend}",
+                      round(_quantile(sorted(per_backend), 0.5), 6))
+        return RooflineSample(
+            cohort=cohort, backend=backend,
+            achieved_gbps=round(achieved, 4),
+            peak_gbps=float(peak), fraction=fraction,
+            expected_fraction=expected, err_pct=err_pct,
+            cold=cold, samples=samples)
+
+    def calibration_err_pct(self) -> Optional[float]:
+        """Running p50 |expected − measured| fraction error (percent),
+        or None before the first observation."""
+        with self._lock:
+            if not self._errs:
+                return None
+            return _quantile(sorted(self._errs), 0.5)
+
+    def cohorts(self) -> Dict[str, dict]:
+        """Read-only per-cohort view for summaries and the bench
+        record: sample counts and fraction quantiles."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for key, stats in self._cohorts.items():
+                fracs = sorted(stats.fractions)
+                out[key] = {
+                    "samples": len(fracs),
+                    "fraction_p50": round(_quantile(fracs, 0.5), 6),
+                    "fraction_p90": round(_quantile(fracs, 0.9), 6),
+                }
+        return out
+
+    # -- persistence -----------------------------------------------------
+
+    def save(self, path: str) -> bool:
+        """Atomically write the CRC-sealed snapshot (tmp + rename).
+        Best-effort: a failing snapshot disk must not take the
+        service down."""
+        with self._lock:
+            payload = {
+                "version": SNAPSHOT_VERSION,
+                "cohorts": {
+                    key: {"fractions": [round(f, 9)
+                                        for f in stats.fractions]}
+                    for key, stats in self._cohorts.items()
+                },
+                "by_backend": {
+                    backend: [round(f, 9) for f in fracs]
+                    for backend, fracs in self._by_backend.items()
+                },
+                "errs": [round(e, 6) for e in self._errs],
+            }
+        payload["crc32"] = _seal(payload)
+        tmp = path + ".tmp"
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, sort_keys=True)
+            os.replace(tmp, path)
+        except (OSError, ValueError):
+            obs.inc("obs.roofline.snapshot.write_errors")
+            return False
+        obs.inc("obs.roofline.snapshot.saves")
+        return True
+
+    def load(self, path: str) -> bool:
+        """Warm-load a snapshot in place. Missing files are silent
+        (cold start is normal); torn/tampered files are skipped
+        AUDIBLY (``obs.roofline.snapshot.torn``) and leave the model
+        cold — a corrupt profile must never steer routing."""
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+        except FileNotFoundError:
+            return False
+        except (OSError, ValueError):
+            obs.inc("obs.roofline.snapshot.torn")
+            return False
+        if not isinstance(payload, dict):
+            obs.inc("obs.roofline.snapshot.torn")
+            return False
+        stored = payload.pop("crc32", None)
+        if stored is None or _seal(payload) != stored:
+            obs.inc("obs.roofline.snapshot.torn")
+            return False
+        if payload.get("version") != SNAPSHOT_VERSION:
+            obs.inc("obs.roofline.snapshot.torn")
+            return False
+        with self._lock:
+            self._cohorts.clear()
+            for key, rec in payload.get("cohorts", {}).items():
+                stats = _CohortStats()
+                for f in rec.get("fractions", []):
+                    stats.fractions.append(float(f))
+                self._cohorts[key] = stats
+            self._by_backend.clear()
+            for backend, fracs in payload.get("by_backend", {}).items():
+                dq = deque(maxlen=SAMPLE_WINDOW)
+                for f in fracs:
+                    dq.append(float(f))
+                self._by_backend[backend] = dq
+            self._errs.clear()
+            for e in payload.get("errs", []):
+                self._errs.append(float(e))
+        obs.inc("obs.roofline.snapshot.loads")
+        return True

@@ -803,8 +803,8 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in session_parsed:
             return _fail(f"exposition lost the {prom_name} counter")
 
-    # 22. Convergence forecasting end to end (runs LAST of all, clean
-    # registry): the analytic cold model seeds a prediction before any
+    # 22. Convergence forecasting end to end (clean registry): the
+    # analytic cold model seeds a prediction before any
     # sample exists, a few completed solves calibrate the cohort, a
     # deadline-doomed request sheds typed `predicted_deadline` at
     # admission with ZERO compute burned (counter-asserted), and the
@@ -859,6 +859,76 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in parsed22:
             return _fail(f"exposition lost the {prom_name} metric")
 
+    # 23. Backend router + roofline observatory (runs LAST, clean
+    # registry, REAL clock so dispatches are measurable): an xla-only
+    # routed service makes cold decisions and feeds measured roofline
+    # fractions, the CRC-sealed roofline snapshot survives a
+    # round-trip (and a torn snapshot is skipped audibly, leaving the
+    # model cold), and the router/roofline counters survive the
+    # Prometheus exposition round trip.
+    from poisson_tpu.obs.roofline import RooflineModel
+    from poisson_tpu.serve import RouterPolicy
+
+    obs_metrics.reset()
+    svc23 = SolveService(
+        ServicePolicy(capacity=16, router=RouterPolicy()), seed=0)
+    outs23 = []
+    # One request per drain → one routed decision per dispatch (a
+    # co-batched drain is a single decision).
+    for k in range(3):
+        if svc23.submit(SolveRequest(request_id=f"rt{k}",
+                                     problem=problem)) is not None:
+            return _fail("routed request shed on admission")
+        outs23.extend(svc23.drain())
+    if not all(o.converged for o in outs23):
+        return _fail(f"routed solves did not converge: "
+                     f"{[o.kind for o in outs23]}")
+    st23 = svc23.stats()
+    if st23["lost"] != 0 or "router" not in st23:
+        return _fail(f"routed service stats degenerate: {st23}")
+    decisions23 = obs_metrics.get("serve.router.decisions")
+    rl_obs23 = obs_metrics.get("obs.roofline.observations")
+    if decisions23 < 3 or st23["router"]["chosen"].get("xla", 0) < 3:
+        return _fail(f"router made too few decisions: "
+                     f"{st23['router']}")
+    if rl_obs23 < 1:
+        return _fail("no dispatch produced a roofline measurement "
+                     "under the real clock")
+    frac23 = svc23._roofline.backend_fraction("xla")
+    if frac23 is None or frac23 <= 0.0:
+        return _fail(f"measured xla roofline fraction degenerate: "
+                     f"{frac23}")
+    rl_path23 = os.path.join(out_dir, "roofline23.json")
+    if not svc23._roofline.save(rl_path23):
+        return _fail("roofline snapshot save failed")
+    model23 = RooflineModel()
+    if not model23.load(rl_path23):
+        return _fail("roofline snapshot load failed")
+    frac23b = model23.backend_fraction("xla")
+    # the snapshot stores fractions rounded to 9 decimals
+    if frac23b is None or abs(frac23b - frac23) > 1e-8:
+        return _fail(f"roofline snapshot round-trip drifted: "
+                     f"{frac23} -> {frac23b}")
+    with open(rl_path23, "r+") as fh:  # tear the seal
+        fh.seek(0)
+        fh.write("{torn!")
+    torn_model23 = RooflineModel()
+    if torn_model23.load(rl_path23):
+        return _fail("torn roofline snapshot was accepted")
+    if obs_metrics.get("obs.roofline.snapshot.torn") != 1:
+        return _fail("torn roofline snapshot was not counted")
+    if torn_model23.backend_fraction("xla") is not None:
+        return _fail("torn roofline snapshot leaked samples")
+    parsed23 = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_serve_router_decisions",
+                      "poisson_tpu_serve_router_cold_decisions",
+                      "poisson_tpu_serve_router_chosen_xla",
+                      "poisson_tpu_obs_roofline_observations",
+                      "poisson_tpu_obs_roofline_fraction",
+                      "poisson_tpu_obs_roofline_snapshot_torn"):
+        if prom_name not in parsed23:
+            return _fail(f"exposition lost the {prom_name} metric")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -885,8 +955,10 @@ def run_selfcheck(out_dir: str) -> int:
           f"solver sessions ok (warm {warm_it21} vs cold {cold_it21} "
           f"it, boundary replay closed {int(adm21)}/{int(done21)}), "
           f"forecasting ok ({int(preds22)} predictions, p50 err "
-          f"{calib22:.1f}%, predicted-deadline shed with 0 compute) "
-          f"({out_dir})")
+          f"{calib22:.1f}%, predicted-deadline shed with 0 compute), "
+          f"backend router ok ({int(decisions23)} decisions, xla "
+          f"measured at {frac23:.2f}x peak, snapshot round-trip + "
+          f"torn-seal audible) ({out_dir})")
     return 0
 
 

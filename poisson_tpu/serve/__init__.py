@@ -91,6 +91,7 @@ from poisson_tpu.serve.types import (
     ForecastPolicy,
     Outcome,
     RetryPolicy,
+    RouterPolicy,
     SCHED_CONTINUOUS,
     SCHED_DRAIN,
     ServicePolicy,
@@ -114,7 +115,7 @@ __all__ = [
     "JournalReplay", "KrylovPolicy",
     "OPEN", "Outcome", "OUTCOME_ERROR",
     "OUTCOME_RESULT", "OUTCOME_SHED", "PendingRequest", "Placement",
-    "PlacementError", "RetryPolicy",
+    "PlacementError", "RetryPolicy", "RouterPolicy",
     "RUNG_MESH", "RUNG_SHED", "RUNG_SINGLE",
     "SCHED_CONTINUOUS", "SCHED_DRAIN", "ServicePolicy",
     "SessionHost", "SessionPolicy", "SessionReplay",

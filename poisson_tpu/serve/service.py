@@ -300,6 +300,34 @@ class SolveService:
             self._forecast = ForecastModel()
             if self._journal is not None:
                 self._forecast.load(snapshot_path(self._journal.path))
+        # Roofline observatory (obs.roofline): always-on measured
+        # bandwidth attribution — every measured dispatch and lane
+        # chunk-step grades its achieved GB/s against the analytic
+        # bytes/iter model for its cohort. Observation never changes
+        # compiled programs (the counters-pillar rule), so unlike the
+        # forecaster it does not hide behind a policy knob. Its
+        # journal-adjacent snapshot warm-loads across restarts for the
+        # same reason the forecaster's does: a recovered service routes
+        # from its previous life's measured evidence.
+        from poisson_tpu.obs.roofline import RooflineModel
+        from poisson_tpu.obs.roofline import \
+            snapshot_path as _roofline_snapshot
+
+        self._roofline = RooflineModel()
+        if self._journal is not None:
+            self._roofline.load(_roofline_snapshot(self._journal.path))
+        # Backend router (serve.router, ServicePolicy.router): cohort
+        # backend choice from the analytic model cold and the roofline
+        # profiles warm, with misprediction sentinels demoting
+        # (backend, device) arms breaker-style. None = off = every
+        # cohort string and program byte-identical to prior releases.
+        self._router = None
+        self._active_decision = None
+        if self.policy.router is not None:
+            from poisson_tpu.serve.router import BackendRouter
+
+            self._router = BackendRouter(self.policy.router,
+                                         self._roofline, clock=clock)
         if self._journal is not None:
             # The journal opens with this incarnation's topology, so a
             # recovery on a DIFFERENT topology can see the change and
@@ -910,9 +938,24 @@ class SolveService:
             return ":defl"
         return ""
 
+    def _backend_token(self, request: SolveRequest) -> str:
+        """The backend segment of the cohort string. Router off (the
+        default) this is the literal ``"xla"`` every prior release
+        wrote — cohorts stay byte-identical. Router on, it is the arm
+        the router would pick for this request (the pure ``peek``:
+        cohort labeling must not tick decision counters or consume
+        half-open probes), so auto-routed traffic forms per-backend
+        cohorts — its breakers, sentinel baselines, and regression
+        records never blend with hand-picked ones."""
+        if self._router is None:
+            return "xla"
+        return self._router.peek(**self._roofline_args(request),
+                                 device_id=self._hw_cohort()[2])
+
     def _cohort(self, request: SolveRequest) -> str:
         p = request.problem
-        base = f"{p.M}x{p.N}:{request.dtype or 'auto'}:xla"
+        base = (f"{p.M}x{p.N}:{request.dtype or 'auto'}:"
+                f"{self._backend_token(request)}")
         # MG requests are their own cohort family: different
         # executables (V-cycle traced into the body), different cost
         # profile, so their own breaker state and — downstream — their
@@ -975,6 +1018,54 @@ class SolveService:
         backlog += sum(e.eta or 0.0 for e in self._delayed)
         obs.gauge("serve.forecast.backlog_seconds", round(backlog, 6))
         return backlog
+
+    # -- roofline observatory + backend router (obs.roofline) ----------
+
+    def _roofline_args(self, request: SolveRequest, batch: int = 1,
+                       verify_every: Optional[int] = None) -> dict:
+        """The roofline-cohort keyword set for one request — the full
+        dispatch identity the measured fraction is attributed to."""
+        from poisson_tpu.solvers.pcg import resolve_dtype
+
+        p = request.problem
+        if verify_every is None:
+            verify_every = self._verify_params()[0]
+        return {
+            "M": p.M, "N": p.N, "batch": max(1, int(batch)),
+            "dtype_bytes": (8 if resolve_dtype(request.dtype)
+                            == "float64" else 4),
+            "preconditioner": self._precond(request) or "jacobi",
+            "verify_every": int(verify_every),
+            "device_kind": self._hw_cohort()[1],
+        }
+
+    def _observe_roofline(self, request: SolveRequest, *,
+                          iterations: int, seconds: float,
+                          batch: int = 1, verify_every: int = 0,
+                          backend: Optional[str] = None) -> None:
+        """Feed one measured dispatch into the roofline observatory,
+        grade it through the router's misprediction sentinel (when the
+        router made the call — lane chunk-steps always run the xla
+        engine and are never graded against a routed arm), and persist
+        the profile snapshot beside the journal. Unmeasurable
+        dispatches (zero wall — VirtualClock) produce no sample, no
+        grade, no write."""
+        decision = self._active_decision
+        if backend is None:
+            backend = (decision.backend if decision is not None
+                       else "xla")
+        sample = self._roofline.observe(
+            backend=backend, iterations=int(iterations),
+            seconds=float(seconds),
+            **self._roofline_args(request, batch=batch,
+                                  verify_every=verify_every))
+        if (self._router is not None and decision is not None
+                and decision.backend == backend):
+            self._router.grade(decision, sample)
+        if sample is not None and self._journal is not None:
+            from poisson_tpu.obs.roofline import snapshot_path
+
+            self._roofline.save(snapshot_path(self._journal.path))
 
     def _reforecast_doomed(self, entry: _Entry, view, table) -> bool:
         """Mid-flight ETA check for a lane occupant: fit the convergence
@@ -1499,6 +1590,16 @@ class SolveService:
         by_member = {table.entries[lane].request.request_id: dk
                      for lane, dk in deltas.items()}
         shares = apportion_compute(secs, by_member)
+        # Roofline: one chunk step of the lane program, attributed to
+        # the longest per-lane iteration delta. Lane tables always run
+        # the xla engine (routed arms apply to drain/solo dispatches),
+        # so the backend is pinned here and no sentinel grades it.
+        if deltas and occupants:
+            self._observe_roofline(
+                occupants[0].request, backend="xla",
+                iterations=max(deltas.values()), seconds=secs,
+                batch=len(occupants),
+                verify_every=self._verify_params(occupants)[0])
         for lane, dk in deltas.items():
             entry = table.entries[lane]
             rid = entry.request.request_id
@@ -1629,6 +1730,25 @@ class SolveService:
         obs.inc("serve.dispatches")
         obs.inc("serve.batch_members", len(batch))
         cohort = self._cohort(head.request)
+        if self._router is not None:
+            # Route this dispatch cohort across the backend arms. The
+            # backend-downshift rung rides the decision (queue pressure
+            # forces the proven xla floor). Execution gate: every arm
+            # still runs today's xla paths (router.executor_backend —
+            # the Pallas kernels have no valid hardware measurement,
+            # BENCH.md), so routing changes evidence and telemetry but
+            # not compiled programs; a non-xla choice is counted as an
+            # executor fallback to keep that gap audible.
+            ve, _ = self._verify_params(batch)
+            self._active_decision = self._router.route(
+                **self._roofline_args(head.request, batch=len(batch),
+                                      verify_every=ve),
+                device_id=(worker.placement.device_id
+                           if worker.placement else 0),
+                queue_fraction=(len(self._queue)
+                                / max(1, policy.capacity)))
+            if self._active_decision.backend != "xla":
+                obs.inc("serve.router.executor_fallbacks")
         # Sticky executables: this worker now holds the cohort's
         # compiled program at this bucket width — routing will prefer
         # it, and a restart warm-up recompiles exactly these widths.
@@ -1717,6 +1837,10 @@ class SolveService:
                 self._error(entry, ERROR_INTERNAL,
                             f"{type(e).__name__}: {e}")
             return
+        finally:
+            # The routing decision is scoped to this dispatch: a stale
+            # one must never grade a later dispatch's measurement.
+            self._active_decision = None
         if member_failed:
             breaker.record_failure()
         else:
@@ -1792,6 +1916,11 @@ class SolveService:
         shares = apportion_compute(
             secs, {e.request.request_id: int(iters[i])
                    for i, e in enumerate(batch)})
+        # Roofline: one fused program moved passes × grid × max(iters)
+        # bytes (padding members ride the longest-running lane).
+        self._observe_roofline(
+            batch[0].request, iterations=int(iters.max()),
+            seconds=secs, batch=len(batch), verify_every=verify_every)
         for i, entry in enumerate(batch):
             rid = entry.request.request_id
             self._flight.add_step(rid, secs, int(iters[i]),
@@ -1872,6 +2001,8 @@ class SolveService:
             self._flight.add_step(rid, secs, iters,
                                   secs if iters else 0.0, did, k=iters)
             self._flight.end(rid, SPAN_RESIDENT, iterations=iters)
+            self._observe_roofline(req, iterations=iters, seconds=secs,
+                                   verify_every=verify_every)
             return self._classify_member(
                 entry, int(result.flag), iters,
                 float(np.max(np.asarray(result.diff))),
@@ -1922,6 +2053,8 @@ class SolveService:
         self._flight.add_step(rid, secs, iters, secs if iters else 0.0,
                               did, k=iters)
         self._flight.end(rid, SPAN_RESIDENT, iterations=iters)
+        self._observe_roofline(req, iterations=iters, seconds=secs,
+                               verify_every=verify_every)
         return self._classify_member(
             entry, int(result.flag), int(result.iterations),
             float(np.max(np.asarray(result.diff))),
@@ -1987,6 +2120,7 @@ class SolveService:
                               did, k=iters)
         self._flight.end(rid, SPAN_RESIDENT, iterations=iters,
                          warm=info["warm_used"])
+        self._observe_roofline(req, iterations=iters, seconds=secs)
         return self._classify_member(
             entry, flag, iters, float(np.max(np.asarray(result.diff))),
             restarts=0, cap=problem.iteration_cap, co_ids=set(),
@@ -2404,6 +2538,8 @@ class SolveService:
             for cohort, b in w.breakers.items():
                 breakers[cohort if single else f"{cohort}@w{w.id}"] = \
                     b.state
+        router = (self._router.stats() if self._router is not None
+                  else None)
         return {
             "admitted": c["admitted"],
             "completed": c["completed"],
@@ -2411,6 +2547,7 @@ class SolveService:
             "shed": c["shed"],
             "recovered": c["recovered"],
             "pending": pending,
+            **({"router": router} if router is not None else {}),
             "lost": (c["admitted"] + c["recovered"]
                      - (c["completed"] + c["errors"] + c["shed"])
                      - pending),

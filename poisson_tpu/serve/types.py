@@ -33,7 +33,7 @@ Outcome kinds:
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional, Union
+from typing import Callable, Optional, Tuple, Union
 
 from poisson_tpu.config import Problem
 from poisson_tpu.integrity.probe import IntegrityPolicy
@@ -374,6 +374,38 @@ class ForecastPolicy:
     history_every: int = 0
 
 
+@dataclasses.dataclass(frozen=True)
+class RouterPolicy:
+    """Backend-router knobs (:mod:`poisson_tpu.serve.router`).
+
+    ``backend``: ``"auto"`` routes per cohort (analytic model cold,
+    measured roofline evidence warm); any explicit backend name pins
+    every dispatch to that arm (falling back to ``xla`` where the arm
+    is unavailable). ``misprediction_fraction``: a measured dispatch
+    landing below this fraction of its cohort's expected roofline
+    fraction is a misprediction (typed ``serve.router.misprediction``
+    event). ``demote_after`` consecutive mispredictions demote the
+    (backend, device_id) arm for ``cooldown_seconds``, then HALF_OPEN
+    with ``half_open_probes`` probe dispatches — a good probe is a
+    ``serve.router.recover``. ``warm_min_samples`` measured samples in
+    a candidate's cohort graduate routing from the cold analytic table
+    to warm measured ranking. ``assume_available`` force-lists Pallas
+    arms on non-TPU hosts — the chaos/test seam that exercises the
+    full routing state machine on CPU. ``downshift_at`` is the
+    degradation ladder's backend-downshift rung: at that queue
+    fraction every dispatch is forced onto the proven ``xla`` floor
+    arm (``serve.degraded.backend_downshift``)."""
+
+    backend: str = "auto"
+    misprediction_fraction: float = 0.5
+    demote_after: int = 2
+    cooldown_seconds: float = 30.0
+    half_open_probes: int = 1
+    warm_min_samples: int = 3
+    assume_available: Tuple[str, ...] = ()
+    downshift_at: float = 0.95
+
+
 # Scheduling modes (ServicePolicy.scheduling):
 SCHED_DRAIN = "drain"            # PR 5 batch-drain: dispatch, wait, repeat
 SCHED_CONTINUOUS = "continuous"  # lane table + refill state machine
@@ -447,6 +479,15 @@ class ServicePolicy:
     pre-emption, and ETA-backlog degradation. None (the default)
     traces nothing, sheds nothing, and predicts nothing — byte- and
     behavior-identical to every prior release.
+
+    ``router`` arms the cost-model backend router
+    (:class:`RouterPolicy` — ``poisson_tpu.serve.router``): per-cohort
+    backend choice (analytic model cold, measured roofline evidence
+    warm), misprediction sentinels with breaker-style arm demotion,
+    and the backend-downshift degradation rung. None (the default)
+    routes nothing — every cohort string, program, and dispatch path
+    stays byte-identical to every prior release (pinned by the
+    ``serve.routed_default_f64`` contracts ledger entry).
     """
 
     capacity: int = 64
@@ -465,3 +506,4 @@ class ServicePolicy:
     krylov: KrylovPolicy = KrylovPolicy()
     session: SessionPolicy = SessionPolicy()
     forecast: Optional[ForecastPolicy] = None
+    router: Optional[RouterPolicy] = None

@@ -106,6 +106,11 @@ deflation-stale-basis       a poisoned/evicted deflation basis makes
                             with a typed audible event — never a wrong
                             answer — and the rebuilt basis serves the
                             tail warm again
+router-mispredict-downshift a slow routed backend lands below its
+                            predicted roofline fraction → typed
+                            misprediction, arm demotion, traffic
+                            downshifts to the xla floor, and a
+                            half-open re-probe recovers the arm
 ==========================  ============================================
 
 Every scenario resets the metrics registry, runs against a
@@ -1991,6 +1996,77 @@ def _forecast_predicted_shed(seed: int) -> dict:
     }, {"iterations": [int(o.iterations) for o in warm],
         "shed_message": (doomed.message if doomed is not None else None),
         "predictions": int(_counter("obs.forecast.predictions"))})
+
+
+@scenario("router-mispredict-downshift", group="router")
+def _router_mispredict_downshift(seed: int) -> dict:
+    """The backend router's misprediction sentinel end to end: the
+    cold analytic model routes a VMEM-sized grid to the resident arm,
+    an injected slow dispatch lands far below the predicted roofline
+    fraction → typed misprediction + (backend, device) demotion,
+    traffic downshifts to the xla floor arm with zero lost requests,
+    and after the cooldown a half-open re-probe measures healthy and
+    recovers the arm. The run must span ≥2 distinct backends and the
+    ledger must close."""
+    from poisson_tpu.serve import (
+        RouterPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+    ticks = {"n": 0}
+
+    def slow_first_dispatch(requests, attempts):
+        # Dispatch #1 (routed to the resident arm by the cold model)
+        # burns 1.0 virtual seconds — achieved GB/s collapses below
+        # the misprediction threshold. Every later dispatch runs at a
+        # healthy 50 µs.
+        ticks["n"] += 1
+        vc.advance(1.0 if ticks["n"] == 1 else 5e-5)
+
+    svc = SolveService(
+        ServicePolicy(
+            capacity=32, degradation=_quiet_degradation(),
+            router=RouterPolicy(
+                assume_available=("pallas_resident",),
+                misprediction_fraction=0.5, demote_after=1,
+                cooldown_seconds=0.05, warm_min_samples=3)),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=slow_first_dispatch)
+    p = _problem()
+    outs = []
+    # One request per drain → one graded dispatch each: slow resident,
+    # then three on the demoted arm's xla fallback.
+    for k in range(4):
+        svc.submit(SolveRequest(request_id=f"r{k}", problem=p))
+        outs.extend(svc.drain())
+    vc.advance(0.06)  # past the demoted arm's cooldown
+    svc.submit(SolveRequest(request_id="probe", problem=p))
+    outs.extend(svc.drain())
+    st = svc.stats()["router"]
+    return _finish("router-mispredict-downshift", seed, {
+        "cold_route_chose_model_arm":
+            st["chosen"].get("pallas_resident", 0) >= 1,
+        "slow_arm_drew_misprediction":
+            _counter("serve.router.mispredictions") >= 1,
+        "demoted_exactly_once":
+            _counter("serve.router.demotions") == 1,
+        "half_open_reprobe_fired":
+            _counter("serve.router.half_opens") >= 1,
+        "healthy_probe_recovered":
+            _counter("serve.router.recoveries") >= 1
+            and not st["demoted_arms"],
+        "traffic_spanned_backends": len(st["chosen"]) >= 2
+        and st["chosen"].get("xla", 0) >= 1,
+        "roofline_measured":
+            _counter("obs.roofline.observations") >= 4,
+        "all_served": len(outs) == 5
+        and all(o.converged for o in outs),
+    }, {"chosen": st["chosen"],
+        "demoted_arms": st["demoted_arms"],
+        "measured_fractions": st["measured_fractions"]})
 
 
 # -- campaign runner ----------------------------------------------------

@@ -157,6 +157,16 @@ def pytest_configure(config):
         "scoreboard dual-source render, sentinel direction pins; "
         "CPU-fast; runs in tier-1, selectable with -m forecast)",
     )
+    config.addinivalue_line(
+        "markers",
+        "router: backend-router & roofline-observatory suite (achieved-"
+        "GB/s attribution arithmetic, snapshot CRC round-trip + torn "
+        "audibility, analytic cold routing table, misprediction → "
+        "demotion → half-open → recovery lifecycle, default-off cohort "
+        "byte-compat, routed-backend regress cohort split, scoreboard "
+        "dual-source render; CPU-fast; runs in tier-1, selectable "
+        "with -m router)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
