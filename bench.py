@@ -1163,14 +1163,17 @@ def _poisson_schedule(requests: int, rate: float, seed: int = 0):
     return schedule
 
 
-def _drive_open_loop(svc, schedule, problem, t0=None, geometries=None):
+def _drive_open_loop(svc, schedule, problem, t0=None, geometries=None,
+                     tenants=None):
     """The open-loop protocol shared by the A/B and fleet serve benches:
     submit the schedule on the wall clock (arrivals never wait for the
     service), pump between arrivals so they join in-flight work, idle in
     small sleeps until the next arrival is due, then drain. Returns
     ``(stats, makespan_seconds)``. ``geometries`` (a list of specs)
     round-robins each arrival onto a geometry family — the
-    ``--geometry-mix`` load shape."""
+    ``--geometry-mix`` load shape. ``tenants`` (a list of names indexed
+    by request id) stamps each arrival with a tenant identity — the
+    ``--tenants`` mixed-tenant load shape."""
     from poisson_tpu.serve import SolveRequest
 
     if t0 is None:
@@ -1184,7 +1187,8 @@ def _drive_open_loop(svc, schedule, problem, t0=None, geometries=None):
                 request_id=rid, problem=problem,
                 rhs_gate=gate, dtype="float32",
                 geometry=(geometries[rid % len(geometries)]
-                          if geometries else None)))
+                          if geometries else None),
+                tenant=tenants[rid] if tenants else None))
             i += 1
         if svc.pump():
             continue
@@ -1392,6 +1396,158 @@ def _serve_openloop_bench(problem, requests: int, rate: float, devices,
     obs.finalize()
     print(json.dumps(record))
     return 0 if record["detail"]["lost"] == 0 else 1
+
+
+def _tenant_mix_string(spec) -> str:
+    """Canonical ``name:weight`` form of a parsed tenant spec — the
+    string regress.py lifts into its cohort key, so it must normalize
+    (``a:1,b:4`` and ``a:1.0,b:4.0`` are the same experiment)."""
+    return ",".join(f"{name}:{weight:g}" for name, weight in spec)
+
+
+def _serve_tenants_bench(problem, requests: int, rate, spec, devices,
+                         platform: str, downgraded: bool = False) -> int:
+    """Mixed-tenant open-loop mode (``--serve R --tenants SPEC
+    [--arrival-rate L]``): sustained solves/sec on the continuous
+    engine with tenancy ON — arrivals are stamped with tenant
+    identities drawn (seeded) proportionally to the spec's weights, the
+    deficit-weighted queue serves them by share, and the record carries
+    per-tenant p99 + shed rate in ONE artifact.
+
+    ``detail.tenant_mix`` (the canonical spec string) joins the
+    regression sentinel's cohort key (``benchmarks/regress.py``): an
+    ``a:1,b:4`` mixed run never judges a single-tenant baseline.
+    """
+    import random
+
+    from poisson_tpu import obs
+    from poisson_tpu.obs import metrics as obs_metrics
+    from poisson_tpu.serve import (
+        DegradationPolicy,
+        ForecastPolicy,
+        RetryPolicy,
+        SCHED_CONTINUOUS,
+        ServicePolicy,
+        SolveService,
+        TenancyPolicy,
+    )
+
+    rate = rate or 40.0
+    max_batch = 4
+    refill_chunk = 50
+    quiet = DegradationPolicy(shrink_padding_at=9.0,
+                              cap_iterations_at=9.0,
+                              downshift_precision_at=9.0)
+    policy = ServicePolicy(
+        capacity=max(4 * requests, 16), max_batch=max_batch,
+        scheduling=SCHED_CONTINUOUS, refill_chunk=refill_chunk,
+        degradation=quiet,
+        retry=RetryPolicy(max_attempts=2, backoff_base=0.01,
+                          backoff_cap=0.1),
+        forecast=ForecastPolicy(),
+        # Quota off: this record measures DWRR fairness under a
+        # share-proportional load, not admission policing (that is the
+        # tenant-noisy-neighbor chaos scenario's job).
+        tenancy=TenancyPolicy(shares=tuple(spec)),
+    )
+    mix = _tenant_mix_string(spec)
+    schedule = _poisson_schedule(requests, rate)
+    # Seeded share-weighted tenant assignment: the same spec + request
+    # count always produces the same mixed load.
+    names = [name for name, _ in spec]
+    weights = [weight for _, weight in spec]
+    tenants = random.Random(1).choices(names, weights=weights,
+                                       k=requests)
+
+    with obs.span("bench.serve_warmup", fence=False, requests=requests):
+        t0 = time.time()
+        warmed = _warm_serve_buckets(problem, "float32", max_batch,
+                                     requests, refill_chunk=refill_chunk)
+        warm_seconds = time.time() - t0
+    obs.inc("time.compile_seconds", warm_seconds)
+
+    svc = SolveService(policy, seed=0)
+    with obs.span("bench.serve_tenants", fence=False, requests=requests,
+                  tenant_mix=mix):
+        stats, makespan = _drive_open_loop(svc, schedule, problem,
+                                           tenants=tenants)
+    sustained = stats["completed"] / makespan if makespan else 0.0
+
+    # Per-tenant attribution from the outcomes themselves (the rid →
+    # tenant assignment is the ground truth; no counter parsing).
+    from poisson_tpu.serve.service import _percentile
+
+    by_tenant = {name: [] for name in names}
+    for o in svc.outcomes():
+        by_tenant[tenants[o.request_id]].append(o)
+    tenant_detail = {}
+    for name, outs in by_tenant.items():
+        done = [o for o in outs if o.kind == "result"]
+        shed = [o for o in outs if o.kind == "shed"]
+        lat = sorted(o.latency_seconds for o in done)
+        tenant_detail[name] = {
+            "share": dict(spec)[name],
+            "assigned": len(outs),
+            "completed": len(done),
+            "shed": len(shed),
+            "shed_rate": round(len(shed) / len(outs), 4) if outs else 0.0,
+            "p99_seconds": (round(_percentile(lat, 0.99), 4)
+                            if lat else None),
+            "p50_seconds": (round(_percentile(lat, 0.50), 4)
+                            if lat else None),
+        }
+
+    record = {
+        "metric": "serve.sustained_solves_per_sec",
+        "value": round(sustained, 3),
+        "unit": "solves/sec",
+        "detail": {
+            "grid": [problem.M, problem.N],
+            "requests": requests,
+            "arrival_rate": rate,
+            "scheduling": "continuous",
+            "completed": stats["completed"],
+            "errors": stats["errors"],
+            "shed": stats["shed"],
+            "lost": stats["lost"],
+            "p99_seconds": round(stats["latency_seconds"]["p99"], 4),
+            "p50_seconds": round(stats["latency_seconds"]["p50"], 4),
+            "makespan_seconds": round(makespan, 4),
+            "refill_splices": obs_metrics.get("serve.refill.splices"),
+            "tenant_promotions": obs_metrics.get(
+                "serve.tenant.promotions"),
+            # Per-tenant attribution (p99, shed rate, share) — the
+            # payload the record exists for. Attribution-only
+            # (contracts ATTRIBUTION_ONLY_DETAIL): regress.py cohorts
+            # on tenant_mix, not on this block.
+            "tenants": tenant_detail,
+            "p99_exemplar": _serve_p99_exemplar(svc),
+            "slowest_requests": _serve_slowest(svc),
+            "warmed_buckets": warmed,
+            "warmup_seconds": round(warm_seconds, 2),
+            "forecast_calibration_err_pct": _forecast_calibration(svc),
+            "dtype": "float32",
+            "backend": "xla_serve",
+            "devices": 1,
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", None),
+            "platform_fallback": downgraded,
+            # Cohort discriminators (benchmarks/regress.py): a mixed-
+            # tenant fair-queued run is a different experiment from the
+            # single-tenant FIFO run at the same rate.
+            "tenant_mix": mix,
+            "fault_load": "clean",
+        },
+    }
+    obs.gauge("serve.sustained_solves_per_sec", record["value"])
+    obs.event("bench.serve_tenants", **{
+        k: v for k, v in record["detail"].items()
+        if k not in ("p99_exemplar", "slowest_requests",
+                     "warmed_buckets")},
+        sustained_solves_per_sec=record["value"])
+    obs.finalize()
+    print(json.dumps(record))
+    return 0 if stats["lost"] == 0 else 1
 
 
 def _serve_fleet_bench(problem, requests: int, workers: int,
@@ -2266,6 +2422,33 @@ def main() -> int:
             print(f"--repeat-fingerprint must be >= 1, got "
                   f"{repeat_fingerprint}", file=sys.stderr)
             return 2
+    tenant_spec = None
+    if "--tenants" in argv:
+        i = argv.index("--tenants")
+        try:
+            raw_spec = argv[i + 1]
+        except IndexError:
+            print("usage: python bench.py --serve R --tenants "
+                  "NAME:WEIGHT[,NAME:WEIGHT...] [--arrival-rate L] [M N]",
+                  file=sys.stderr)
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+        if serve_requests is None:
+            print("--tenants is a --serve mode option", file=sys.stderr)
+            return 2
+        if (serve_workers is not None or geometry_mix is not None
+                or repeat_fingerprint is not None):
+            print("--tenants, --workers, --geometry-mix, and "
+                  "--repeat-fingerprint are separate serve experiments; "
+                  "pick one", file=sys.stderr)
+            return 2
+        from poisson_tpu.serve import parse_tenant_spec
+
+        try:
+            tenant_spec = parse_tenant_spec(raw_spec)
+        except ValueError as e:
+            print(f"--tenants: {e}", file=sys.stderr)
+            return 2
     serve_router = False
     if "--router" in argv:
         i = argv.index("--router")
@@ -2274,10 +2457,11 @@ def main() -> int:
             print("--router is a --serve mode option", file=sys.stderr)
             return 2
         if (serve_workers is not None or geometry_mix is not None
-                or repeat_fingerprint is not None):
+                or repeat_fingerprint is not None
+                or tenant_spec is not None):
             print("--router rides the plain and open-loop serve modes; "
-                  "drop --workers/--geometry-mix/--repeat-fingerprint",
-                  file=sys.stderr)
+                  "drop --workers/--geometry-mix/--repeat-fingerprint/"
+                  "--tenants", file=sys.stderr)
             return 2
         serve_router = True
     if batch is not None and serve_requests is not None:
@@ -2381,6 +2565,11 @@ def main() -> int:
                                       downgraded=downgraded,
                                       fleet_devices=fleet_devices,
                                       kill_device_at=kill_device_at)
+        if tenant_spec is not None:
+            return _serve_tenants_bench(problem, serve_requests,
+                                        arrival_rate, tenant_spec,
+                                        devices, platform,
+                                        downgraded=downgraded)
         if arrival_rate is not None:
             return _serve_openloop_bench(problem, serve_requests,
                                          arrival_rate, devices, platform,

@@ -123,6 +123,7 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
                session: Optional[bool] = None,
                warm_start: Optional[bool] = None,
                routed_backend: Optional[str] = None,
+               tenant_mix: Optional[str] = None,
                note: Optional[str] = None) -> dict:
     return {
         "source": source,
@@ -196,6 +197,14 @@ def _mk_record(source: str, *, value=None, metric=None, platform=None,
         # "off" (the stamped default) and None (pre-router artifacts)
         # normalize to the same cohort: old baselines stay comparable.
         "routed_backend": routed_backend or "off",
+        # Mixed-tenant records (bench.py --serve --tenants SPEC): the
+        # canonical tenant mix is experiment identity — a fair-queued
+        # a:1,b:4 load's percentiles form under deficit-weighted
+        # service, so they never judge (or hide behind) a single-tenant
+        # FIFO baseline. "off" (the stamped default) and None
+        # (pre-tenancy artifacts) normalize to the same cohort: old
+        # baselines stay comparable.
+        "tenant_mix": tenant_mix or "off",
         "failed": bool(failed),
         "note": note,
     }
@@ -240,6 +249,7 @@ def record_from_result(result: dict, source: str,
         session=det.get("session"),
         warm_start=det.get("warm_start"),
         routed_backend=det.get("routed_backend"),
+        tenant_mix=det.get("tenant_mix"),
     )
 
 
@@ -359,7 +369,9 @@ def cohort_key(rec: dict):
     never judges a Jacobi one; a block batch never judges the
     independent family; a warm repeat-fingerprint run never judges a
     cold baseline; a warm-started session stream never judges
-    independent cold solves — or vice versa, all of them)."""
+    independent cold solves; a fair-queued mixed-tenant run never
+    judges a single-tenant FIFO baseline — or vice versa, all of
+    them)."""
     return (rec.get("metric"), tuple(rec.get("grid") or ()),
             rec.get("dtype"), rec.get("platform"), rec.get("backend"),
             rec.get("devices"), rec.get("fault_load"),
@@ -369,7 +381,8 @@ def cohort_key(rec: dict):
             rec.get("krylov_mode"), rec.get("deflation"),
             rec.get("repeat_fingerprint"),
             rec.get("session"), rec.get("warm_start"),
-            rec.get("routed_backend") or "off")
+            rec.get("routed_backend") or "off",
+            rec.get("tenant_mix") or "off")
 
 
 def _threshold(others: list[float], k: float, rel_tol: float,

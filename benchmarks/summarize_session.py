@@ -588,6 +588,69 @@ def telemetry_report(tdir: pathlib.Path) -> int:
                          f"{attrs.get('threshold')})")
             print(line)
 
+    # Tenant fairness (serve.tenancy): per-tenant shares, quota/retry
+    # budgets, outcome tallies, and the fair-queue/quota sentinel
+    # counters — the section a noisy-neighbor post-mortem starts from.
+    tenant_counters = {name: val for name, val in counters.items()
+                       if name.startswith("serve.tenant.")}
+    tenant_gauges: dict = {}
+    for _rank in sorted(gauges_by_rank):
+        for name, val in (gauges_by_rank[_rank] or {}).items():
+            if (name.startswith("serve.tenant.")
+                    and isinstance(val, (int, float))):
+                tenant_gauges.setdefault(name, val)
+    if tenant_counters or tenant_gauges:
+        print("\n## Tenant fairness\n")
+
+        def _per_tenant(prefix, source):
+            return {name[len(prefix) + 1:]: val
+                    for name, val in source.items()
+                    if name.startswith(prefix + ".")}
+
+        shares = _per_tenant("serve.tenant.share", tenant_gauges)
+        quota_tok = _per_tenant("serve.tenant.quota_tokens",
+                                tenant_gauges)
+        retry_tok = _per_tenant("serve.tenant.retry_tokens",
+                                tenant_gauges)
+        slo_burn = _per_tenant("serve.tenant.slo_burn", tenant_gauges)
+        admitted = _per_tenant("serve.tenant.admitted", tenant_counters)
+        completed = _per_tenant("serve.tenant.completed",
+                                tenant_counters)
+        shed = _per_tenant("serve.tenant.shed", tenant_counters)
+        errors = _per_tenant("serve.tenant.errors", tenant_counters)
+        retries = _per_tenant("serve.tenant.retries", tenant_counters)
+        names = sorted(set(shares) | set(admitted) | set(completed))
+        if names:
+            print("| tenant | share | admitted | completed | errors "
+                  "| shed | retries | quota tokens | retry budget "
+                  "| SLO burn |")
+            print("|---|---|---|---|---|---|---|---|---|---|")
+            for t in names:
+                rt = retry_tok.get(t)
+                rt_txt = ("off" if rt is not None and rt < 0
+                          else _fmt(rt) if rt is not None else "-")
+                print(f"| {t} | {_fmt(shares.get(t))} "
+                      f"| {int(admitted.get(t, 0))} "
+                      f"| {int(completed.get(t, 0))} "
+                      f"| {int(errors.get(t, 0))} "
+                      f"| {int(shed.get(t, 0))} "
+                      f"| {int(retries.get(t, 0))} "
+                      f"| {_fmt(quota_tok.get(t)) if t in quota_tok else '-'} "
+                      f"| {rt_txt} "
+                      f"| {_fmt(slo_burn.get(t)) if t in slo_burn else '-'} |")
+        print(f"\n{int(tenant_counters.get('serve.tenant.quota_sheds', 0))} "
+              f"quota shed(s) (typed quota_exceeded, zero compute), "
+              f"{int(tenant_counters.get('serve.tenant.promotions', 0))} "
+              f"fair-queue promotion(s), "
+              f"{int(tenant_counters.get('serve.tenant.lane_deferred', 0))} "
+              f"lane-share deferral(s), "
+              f"{int(tenant_counters.get('serve.tenant.retry_exhausted', 0))} "
+              f"retry-budget exhaustion(s), "
+              f"{int(tenant_counters.get('serve.tenant.degraded_offender', 0))} "
+              f"offender-first degradation(s) vs "
+              f"{int(tenant_counters.get('serve.tenant.degraded_spared', 0))} "
+              f"spared.")
+
     # Flight recorder (obs.flight): per-request causal traces and their
     # latency decompositions — render the aggregate view plus ONE
     # request's end-to-end timeline (the slowest, the request a p99

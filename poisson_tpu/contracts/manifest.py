@@ -638,6 +638,12 @@ ATTRIBUTION_ONLY_DETAIL = {
     "shed_rate": "outcome-rate payload (its own gauge exists)",
     "throughput_rps": "derived reading of the same run",
     "wall_seconds": "raw timing payload",
+    # mixed-tenant attribution (cohort split rides on
+    # detail.tenant_mix, which regress.py lifts into the key)
+    "tenants": "per-tenant p99/shed-rate/share attribution block; "
+               "detail.tenant_mix is the cohort discriminator "
+               "regress.py lifts",
+    "tenant_promotions": "fair-queue telemetry snapshot",
 }
 
 # ServicePolicy/FleetPolicy fields a chaos scenario need not exercise —

@@ -364,11 +364,19 @@ class SLOTracker:
     threshold (the short window says "burning now", the long window
     says "not just a blip") — which is what makes an SLO-driven
     downshift deliberate rather than twitchy.
+
+    ``prefix`` names the published metric family — the default
+    ``"serve.slo"`` is the fleet-wide surface every prior release
+    published; per-tenant trackers (``ServicePolicy.tenancy``) pass
+    ``serve.tenant.slo.<tenant>`` so one tenant's burn is attributable
+    without double-counting the global counters.
     """
 
-    def __init__(self, policy, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, policy, clock: Callable[[], float] = time.monotonic,
+                 prefix: str = "serve.slo"):
         self.policy = policy
         self._clock = clock
+        self._prefix = prefix
         self._hist = LatencyHistogram()
         # One (timestamps, running-bad) pair per window: append on
         # record, evict expired samples from the head — amortized O(1)
@@ -388,10 +396,10 @@ class SLOTracker:
         bad = 0 if good else 1
         if good:
             self._good += 1
-            metrics.inc("serve.slo.good")
+            metrics.inc(f"{self._prefix}.good")
         else:
             self._bad += 1
-            metrics.inc("serve.slo.bad")
+            metrics.inc(f"{self._prefix}.bad")
         for w, st in self._windows.items():
             st["dq"].append((t, bad))
             st["total"] += 1
@@ -457,13 +465,14 @@ class SLOTracker:
         return level
 
     def publish(self) -> None:
-        metrics.gauge("serve.slo.latency_seconds", self._hist.snapshot())
-        metrics.gauge("serve.slo.budget_remaining",
+        metrics.gauge(f"{self._prefix}.latency_seconds",
+                      self._hist.snapshot())
+        metrics.gauge(f"{self._prefix}.budget_remaining",
                       round(self.budget_remaining(), 6))
-        metrics.gauge("serve.slo.objective_seconds",
+        metrics.gauge(f"{self._prefix}.objective_seconds",
                       self.policy.latency_objective_seconds)
         for w in self.policy.burn_windows:
-            metrics.gauge(f"serve.slo.burn_rate.{w:g}s",
+            metrics.gauge(f"{self._prefix}.burn_rate.{w:g}s",
                           round(self.burn_rate(w), 4))
 
 

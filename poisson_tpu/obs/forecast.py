@@ -583,6 +583,19 @@ def build_scoreboard(metrics: dict) -> dict:
         "calibration_err_pct":
             _get(flat, "obs.roofline.calibration_err_pct"),
     }
+    # Per-tenant fairness pane (PR 20): the scan keys are the tenant
+    # names, so the pane reads identically from a live snapshot, a
+    # parsed Prometheus page, and a dead metrics dir.  With tenancy off
+    # none of these gauges exist and the section is an empty dict.
+    tenants = {
+        "shares": _prefix_scan(flat, "serve.tenant.share"),
+        "quota_tokens": _prefix_scan(flat, "serve.tenant.quota_tokens"),
+        "retry_tokens": _prefix_scan(flat, "serve.tenant.retry_tokens"),
+        "slo_burn": _prefix_scan(flat, "serve.tenant.slo_burn"),
+        "shed": _prefix_scan(flat, "serve.tenant.shed"),
+        "quota_sheds": _get(flat, "serve.tenant.quota_sheds"),
+        "retry_exhausted": _get(flat, "serve.tenant.retry_exhausted"),
+    }
     return {
         "queue": queue,
         "lanes": lanes,
@@ -592,6 +605,7 @@ def build_scoreboard(metrics: dict) -> dict:
         "placement": placement,
         "forecast": forecast,
         "backends": backends,
+        "tenants": tenants,
     }
 
 
@@ -659,4 +673,25 @@ def render_scoreboard(board: dict) -> str:
                 else "")
              for arm, n in sorted((bk.get("chosen") or {}).items()))),
     ]
+    # Older snapshots (pre-tenancy) have no tenants section, and a
+    # tenancy-off process emits none of the gauges: only render the
+    # pane when at least one tenant is visible.
+    tn = board.get("tenants") or {}
+    tenant_names = sorted(
+        set(tn.get("shares") or {})
+        | set(tn.get("quota_tokens") or {})
+        | set(tn.get("retry_tokens") or {}))
+    if tenant_names:
+        lines.append(
+            f"tenants   quota_sheds {_cell(tn.get('quota_sheds'))}"
+            f"  retry_exhausted {_cell(tn.get('retry_exhausted'))}")
+        for name in tenant_names:
+            retry = (tn.get("retry_tokens") or {}).get(name)
+            lines.append(
+                f"  {name:<8}"
+                f" share {_cell((tn.get('shares') or {}).get(name), '{:g}')}"
+                f"  quota {_cell((tn.get('quota_tokens') or {}).get(name), '{:.1f}')}"
+                f"  retry {'off' if retry is not None and retry < 0 else _cell(retry, '{:.1f}')}"
+                f"  shed {_cell((tn.get('shed') or {}).get(name))}"
+                f"  slo_burn {_cell((tn.get('slo_burn') or {}).get(name), '{:.2f}')}")
     return "\n".join(lines)

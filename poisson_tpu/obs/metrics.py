@@ -232,6 +232,41 @@ Naming convention (dotted, low cardinality):
   ``serve.degraded.slo_driven`` counts load-level decisions where the
   burn rate (not queue depth) chose the degradation rung
   (``SLOPolicy.degrade_on_burn``);
+- the ``serve.tenant`` family — tenant isolation & overload fairness
+  (:mod:`poisson_tpu.serve.tenancy`, ``ServicePolicy.tenancy``; the
+  whole family is silent with tenancy off):
+  ``serve.tenant.quota_sheds`` — admissions refused by a tenant's
+  token-bucket quota (each is also a typed ``serve.shed.quota_exceeded``
+  outcome with zero compute burned);
+  ``serve.tenant.promotions`` — deficit-weighted-round-robin head
+  rotations (a pump where the fair-share pick was not already at the
+  queue front; within-tenant FIFO order is preserved);
+  ``serve.tenant.lane_deferred`` — refill splices deferred because the
+  candidate's tenant already held its fair share of the bucket's lanes
+  while a competitor had eligible work waiting (deferred to the next
+  refill, never shed);
+  ``serve.tenant.retry_exhausted`` — retries converted into typed
+  errors because the tenant's retry budget was empty (each also emits
+  a ``serve.tenant.retry_exhausted`` event; the budget bounds a
+  poisoned tenant's dispatches at admitted + retry_budget);
+  ``serve.tenant.degraded_offender`` / ``serve.tenant.degraded_spared``
+  — tenant-scoped degradation decisions: dispatches/splices that paid
+  the full queue-pressure rung as the offending tenant (largest
+  backlog/share ratio) vs ran one rung gentler as a non-offender;
+  per-tenant counters ``serve.tenant.{admitted,completed,errors,shed,
+  retries,dispatches}.<tenant>`` — the tenant-split ledger (the global
+  ``serve.*`` equation restricted to one client; the chaos campaign
+  closes it per tenant);
+  the ``serve.tenant.slo.<tenant>.*`` family — one
+  ``obs.flight.SLOTracker`` per tenant publishing good/bad counters,
+  the latency histogram, budget and burn-rate gauges under the
+  tenant's own prefix (the global ``serve.slo.*`` surface is scored
+  exactly once, by the fleet tracker);
+  gauges ``serve.tenant.share.<tenant>`` (configured relative weight),
+  ``serve.tenant.quota_tokens.<tenant>`` (admission bucket level),
+  ``serve.tenant.retry_tokens.<tenant>`` (remaining retry budget; -1 =
+  budgeting off), and ``serve.tenant.slo_burn.<tenant>`` (the
+  shortest-window burn rate — the scoreboard's per-tenant SLO column);
 - the ``session`` family — durable solver sessions (ordered streams of
   dependent solves: :mod:`poisson_tpu.serve.session` hosts them,
   :mod:`poisson_tpu.solvers.session` runs the steps):

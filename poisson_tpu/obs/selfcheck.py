@@ -49,7 +49,14 @@ surviving exposition. Step 20 (runs LAST of all, clean registry)
 proves the Krylov memory (``poisson_tpu.krylov``): a cold solve
 harvests a deflation basis, the warm solve of the same operator
 converges in strictly fewer iterations off the cache, and the
-``krylov_*`` counters survive Prometheus exposition.
+``krylov_*`` counters survive Prometheus exposition. Step 24 (runs
+LAST of all, clean registry) proves tenant isolation & overload
+fairness (``poisson_tpu.serve.tenancy``): an over-quota tenant is
+refused at admission (typed ``quota_exceeded`` shed, zero compute),
+the deficit-weighted queue promotes a starved tenant past a deep FIFO
+backlog, a poisoned tenant's requeues are capped by its retry budget
+(dispatches ≤ admitted + budget, exhaustion a typed error), and the
+``serve_tenant_*`` counters survive Prometheus exposition.
 
 Exit 0 on success, 1 with a reason on the first failure. ``--dir`` keeps
 the artifacts for inspection (default: a temp dir, removed afterwards).
@@ -929,6 +936,105 @@ def run_selfcheck(out_dir: str) -> int:
         if prom_name not in parsed23:
             return _fail(f"exposition lost the {prom_name} metric")
 
+    # 24. Tenant isolation & overload fairness (runs LAST of all, clean
+    # registry): a token-bucket quota refuses an over-quota tenant at
+    # admission (typed quota_exceeded shed, zero compute burned), the
+    # deficit-weighted queue serves a late-arriving tenant ahead of a
+    # deep FIFO backlog, a poisoned tenant's requeues are capped by its
+    # retry budget (dispatches <= admitted + budget, exhaustion a typed
+    # error), and the serve_tenant_* counters survive the Prometheus
+    # exposition round trip.
+    from poisson_tpu.serve import (
+        BreakerPolicy,
+        RetryPolicy,
+        SHED_QUOTA_EXCEEDED,
+        TenancyPolicy,
+    )
+
+    obs_metrics.reset()
+    vc24 = VirtualClock()
+    # (a) quota: tenant "b" has bucket 2 and submits 4 — two admitted,
+    # two refused with zero compute.
+    svc24a = SolveService(
+        ServicePolicy(capacity=16,
+                      tenancy=TenancyPolicy(quota_rate=1e-3,
+                                            quota_burst=2.0)),
+        clock=vc24, sleep=vc24.sleep, seed=0)
+    quota_sheds24 = []
+    for k in range(4):
+        out = svc24a.submit(SolveRequest(request_id=f"q{k}",
+                                         problem=problem, tenant="b"))
+        if out is not None:
+            quota_sheds24.append(out)
+    svc24a.drain()
+    if len(quota_sheds24) != 2 or any(
+            o.shed_reason != SHED_QUOTA_EXCEEDED for o in quota_sheds24):
+        return _fail(f"quota admission wrong: "
+                     f"{[o.shed_reason for o in quota_sheds24]}")
+    if any((o.decomposition or {}).get("compute_s", 1) != 0
+           or (o.decomposition or {}).get("dispatches", 1) != 0
+           for o in quota_sheds24):
+        return _fail("quota shed burned compute")
+    if obs_metrics.get("serve.tenant.quota_sheds") != 2:
+        return _fail("quota sheds not counted")
+    # (b) DWRR fairness: 6 FIFO-queued "big" requests, then 2 from
+    # "small" — the fair queue serves small's first request among the
+    # first two dispatches instead of position 7.
+    svc24b = SolveService(
+        ServicePolicy(capacity=16, max_batch=1,
+                      tenancy=TenancyPolicy()),
+        clock=vc24, sleep=vc24.sleep, seed=0)
+    for k in range(6):
+        svc24b.submit(SolveRequest(request_id=f"big{k}",
+                                   problem=problem, tenant="big"))
+    for k in range(2):
+        svc24b.submit(SolveRequest(request_id=f"small{k}",
+                                   problem=problem, tenant="small"))
+    order24 = [o.request_id for o in svc24b.drain()]
+    if not any(rid.startswith("small") for rid in order24[:2]):
+        return _fail(f"fair queue did not promote the starved tenant: "
+                     f"{order24}")
+    if obs_metrics.get("serve.tenant.promotions") < 1:
+        return _fail("tenant promotions not counted")
+    # (c) retry budget: every "poison" dispatch dies; its requeues are
+    # budget-capped and the exhaustion is a typed transient error.
+    from poisson_tpu.serve.types import TransientDispatchError
+
+    def poison24(requests, attempts):
+        if any(str(r.request_id).startswith("p") for r in requests):
+            raise TransientDispatchError("selfcheck poison")
+
+    budget24 = 2
+    svc24c = SolveService(
+        ServicePolicy(
+            capacity=16,
+            retry=RetryPolicy(max_attempts=50, backoff_base=0.01,
+                              backoff_cap=0.05),
+            breaker=BreakerPolicy(failure_threshold=10**6),
+            tenancy=TenancyPolicy(retry_budget=budget24)),
+        clock=vc24, sleep=vc24.sleep, seed=0,
+        dispatch_fault=poison24)
+    svc24c.submit(SolveRequest(request_id="p0", problem=problem,
+                               tenant="poison"))
+    out24 = svc24c.drain()
+    disp24 = obs_metrics.get("serve.tenant.dispatches.poison")
+    if not (0 < disp24 <= 1 + budget24):
+        return _fail(f"retry amplification uncapped: {disp24} dispatches "
+                     f"for 1 admitted + budget {budget24}")
+    if (obs_metrics.get("serve.tenant.retry_exhausted") != 1
+            or len(out24) != 1 or out24[0].kind != "error"):
+        return _fail(f"budget exhaustion not a typed error: {out24}")
+    parsed24 = export.parse_text(export.render())
+    for prom_name in ("poisson_tpu_serve_tenant_quota_sheds",
+                      "poisson_tpu_serve_shed_quota_exceeded",
+                      "poisson_tpu_serve_tenant_promotions",
+                      "poisson_tpu_serve_tenant_retry_exhausted",
+                      "poisson_tpu_serve_tenant_dispatches_poison",
+                      "poisson_tpu_serve_tenant_share_b",
+                      "poisson_tpu_serve_tenant_retry_tokens_poison"):
+        if prom_name not in parsed24:
+            return _fail(f"exposition lost the {prom_name} metric")
+
     print(f"obs selfcheck OK: {len(events)} trace events, {span_ends} "
           f"spans, {len(samples)} stream samples, "
           f"{len(counters)} counters, model agreement {agree:.2f}x, "
@@ -958,7 +1064,10 @@ def run_selfcheck(out_dir: str) -> int:
           f"{calib22:.1f}%, predicted-deadline shed with 0 compute), "
           f"backend router ok ({int(decisions23)} decisions, xla "
           f"measured at {frac23:.2f}x peak, snapshot round-trip + "
-          f"torn-seal audible) ({out_dir})")
+          f"torn-seal audible), tenant fairness ok "
+          f"({len(quota_sheds24)} quota sheds at 0 compute, starved "
+          f"tenant promoted, poison capped at {int(disp24)} dispatches) "
+          f"({out_dir})")
     return 0
 
 

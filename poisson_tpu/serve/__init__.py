@@ -69,6 +69,12 @@ from poisson_tpu.serve.journal import (
     replay_sessions,
 )
 from poisson_tpu.serve.session import SessionHost, SolveSession
+from poisson_tpu.serve.tenancy import (
+    DEFAULT_TENANT,
+    TenancyPolicy,
+    TenantLedger,
+    parse_tenant_spec,
+)
 from poisson_tpu.serve.service import (
     SolveService,
     p99_exemplar,
@@ -100,6 +106,7 @@ from poisson_tpu.serve.types import (
     SHED_DEADLINE_EXPIRED,
     SHED_PREDICTED_DEADLINE,
     SHED_QUEUE_FULL,
+    SHED_QUOTA_EXCEEDED,
     SLOPolicy,
     SolveRequest,
     TransientDispatchError,
@@ -107,6 +114,7 @@ from poisson_tpu.serve.types import (
 
 __all__ = [
     "BreakerPolicy", "CircuitBreaker", "CLOSED", "Deadline",
+    "DEFAULT_TENANT",
     "DegradationPolicy", "DeviceLossError", "DeviceRegistry",
     "ERROR_DIVERGENCE", "ERROR_INTEGRITY",
     "ERROR_INTERNAL", "ERROR_PLACEMENT",
@@ -121,10 +129,12 @@ __all__ = [
     "SessionHost", "SessionPolicy", "SessionReplay",
     "SHED_BREAKER_OPEN", "SHED_DEADLINE_EXPIRED",
     "SHED_PREDICTED_DEADLINE", "SHED_QUEUE_FULL",
+    "SHED_QUOTA_EXCEEDED",
     "SLOPolicy", "SolveJournal", "SolveRequest", "SolveService",
-    "SolveSession",
+    "SolveSession", "TenancyPolicy", "TenantLedger",
     "TransientDispatchError", "WORKER_DEAD", "WORKER_QUARANTINED",
     "WORKER_RUNNING", "Worker", "WorkerCrashError", "WorkerHangError",
-    "WorkerPool", "elastic_plan", "p99_exemplar", "replay_journal",
+    "WorkerPool", "elastic_plan", "p99_exemplar", "parse_tenant_spec",
+    "replay_journal",
     "replay_sessions", "slowest_requests",
 ]

@@ -117,6 +117,13 @@ class SolveJournal:
 
     def submit(self, request: SolveRequest, trace_id: str) -> None:
         req = {k: getattr(request, k) for k in _REQUEST_FIELDS}
+        if request.tenant is not None:
+            # Tenant identity rides the journal (only when set, so
+            # tenancy-off journals stay byte-identical): a recovery
+            # must rebuild each tenant's fair share and re-charge its
+            # retry budget — a poisoned tenant cannot launder its
+            # amplification cap through a process crash.
+            req["tenant"] = request.tenant
         if request.geometry is not None:
             # The spec's canonical JSON reconstructs the geometry on
             # replay (raw-SDF specs serialize name-only and replay as

@@ -115,6 +115,7 @@ from poisson_tpu.serve.types import (
     SHED_DEADLINE_EXPIRED,
     SHED_PREDICTED_DEADLINE,
     SHED_QUEUE_FULL,
+    SHED_QUOTA_EXCEEDED,
     SolveRequest,
     TransientDispatchError,
 )
@@ -286,6 +287,20 @@ class SolveService:
         # unconfigured.
         self._flight = FlightRecorder(clock=clock)
         self._slo = SLOTracker(self.policy.slo, clock=clock)
+        # Tenant ledger (serve.tenancy, ServicePolicy.tenancy): quota
+        # buckets, deficit-weighted round-robin counters, and retry
+        # budgets per tenant — plus one SLOTracker per tenant publishing
+        # under serve.tenant.slo.<tenant> so a noisy neighbor's burn is
+        # attributable without touching the global serve.slo.* surface.
+        # None (the default) is the strict-FIFO service of every prior
+        # release, byte-compatible.
+        self._tenancy = None
+        self._tenant_slo: dict = {}
+        self._offender: Optional[str] = None
+        if self.policy.tenancy is not None:
+            from poisson_tpu.serve.tenancy import TenantLedger
+
+            self._tenancy = TenantLedger(self.policy.tenancy, clock=clock)
         # Iteration forecaster (obs.forecast, ServicePolicy.forecast):
         # per-cohort iteration/cost estimator behind predicted-deadline
         # admission, lane re-forecast preemption, and the ETA backlog
@@ -463,6 +478,9 @@ class SolveService:
             return out
         self._counts["admitted"] += 1
         obs.inc("serve.admitted")
+        tenant = self._tenant(request)
+        if tenant is not None:
+            obs.inc(f"serve.tenant.admitted.{tenant}")
         trace_id = self._flight.admit(request.request_id)  # trace root
         if self._journal is not None:
             self._journal.submit(request, trace_id)
@@ -470,6 +488,18 @@ class SolveService:
         deadline = (Deadline(request.deadline_seconds, clock=self._clock)
                     if request.deadline_seconds is not None else None)
         entry = _Entry(request, now, deadline)
+        if self._tenancy is not None and not self._tenancy.admit(tenant):
+            # Per-tenant token-bucket quota: over-quota is a typed shed
+            # with ZERO compute burned — refused here, before any
+            # dispatch, through the same _shed path as queue_full, so
+            # the ledger invariant closes unchanged and one hot client
+            # cannot convert its overload into everyone's queue time.
+            obs.inc("serve.tenant.quota_sheds")
+            return self._shed(
+                entry, SHED_QUOTA_EXCEEDED,
+                f"tenant {tenant!r} over admission quota "
+                f"({self.policy.tenancy.quota_rate:g}/s × share "
+                f"{self._tenancy.share_of(tenant):g})")
         depth = len(self._queue) + len(self._delayed)
         if depth >= self.policy.capacity:
             return self._shed(entry, SHED_QUEUE_FULL,
@@ -496,7 +526,11 @@ class SolveService:
                         f"(cohort {fc.cohort}, "
                         f"{'cold' if fc.cold else 'calibrated'} model)")
         self._pending_ids.add(request.request_id)
-        self._flight.begin(request.request_id, SPAN_QUEUE)
+        if tenant is not None:
+            self._flight.begin(request.request_id, SPAN_QUEUE,
+                               tenant=tenant)
+        else:
+            self._flight.begin(request.request_id, SPAN_QUEUE)
         self._queue.append(entry)
         obs.gauge("serve.queue_depth", len(self._queue) + len(self._delayed))
         return None
@@ -528,6 +562,16 @@ class SolveService:
         dead — fails the remaining backlog with typed internal errors,
         so the ledger invariant survives even total fleet loss."""
         self._restart_due_workers()
+        if self._tenancy is not None:
+            # Weighted-fair head selection happens ONCE per pump,
+            # before any head-based routing (placement pin, basis
+            # stickiness, sticky cohort) reads queue[0]: pull due
+            # backed-off entries in first so the DWRR pick sees the
+            # real backlog, then rotate the picked tenant's oldest
+            # entry to the head. FIFO order *within* a tenant is
+            # preserved — shares reorder across tenants only.
+            self._pump_delayed()
+            self._promote_tenant_head()
         pinned = self._pinned_head_worker()
         if pinned is not None:
             worker, verdict = pinned
@@ -575,6 +619,74 @@ class SolveService:
         if not self._queue:
             return None
         return self._cohort(self._queue[0].request)
+
+    # -- tenant isolation (serve.tenancy) ------------------------------
+
+    def _tenant(self, request: SolveRequest) -> Optional[str]:
+        """The request's ledger tenant — None iff tenancy is off (the
+        tenant field is then inert metadata, costing nothing)."""
+        if self._tenancy is None:
+            return None
+        return self._tenancy.resolve(request.tenant)
+
+    def _tenant_slo_tracker(self, tenant: str) -> SLOTracker:
+        tracker = self._tenant_slo.get(tenant)
+        if tracker is None:
+            tracker = SLOTracker(self.policy.slo, clock=self._clock,
+                                 prefix=f"serve.tenant.slo.{tenant}")
+            self._tenant_slo[tenant] = tracker
+        return tracker
+
+    def _promote_tenant_head(self) -> None:
+        """Deficit-weighted round-robin head selection: rotate the
+        picked tenant's oldest queued entry to the queue front. One
+        pick per pump — over any window the dispatch-head mix
+        converges to the share vector regardless of arrival order."""
+        if len(self._queue) < 2:
+            return
+        backlogged = sorted({self._tenant(e.request) for e in self._queue})
+        if len(backlogged) < 2:
+            return
+        pick = self._tenancy.pick(backlogged)
+        if self._tenant(self._queue[0].request) == pick:
+            return
+        for i, entry in enumerate(self._queue):
+            if self._tenant(entry.request) == pick:
+                del self._queue[i]
+                self._queue.appendleft(entry)
+                obs.inc("serve.tenant.promotions")
+                return
+
+    def _tenant_offender(self) -> Optional[str]:
+        """The tenant whose backlog most exceeds its share — the one
+        the degradation ladder downshifts first (tenant-scoped
+        Hochschild-style indictment: blame the client, not the
+        queue)."""
+        backlog: dict = {}
+        for entry in list(self._queue) + self._delayed:
+            t = self._tenant(entry.request)
+            backlog[t] = backlog.get(t, 0) + 1
+        return self._tenancy.offender(backlog)
+
+    def _tenant_level(self, entry: _Entry, level: int,
+                      count: bool = False) -> int:
+        """Tenant-scoped degradation: the offending tenant pays the
+        full queue-pressure rung, every other tenant runs one rung
+        gentler (``TenancyPolicy.isolate_degradation``). ``count``
+        makes the spared/charged decision audible — set only at the
+        application sites (dispatch, lane splice), not in cohort
+        probes, so the counters read as decisions, not scans."""
+        if (self._tenancy is None or level <= 0
+                or not self.policy.tenancy.isolate_degradation
+                or self._offender is None):
+            return level
+        if self._tenant(entry.request) == self._offender:
+            if count:
+                obs.inc("serve.tenant.degraded_offender")
+            return level
+        if count:
+            obs.inc("serve.tenant.degraded_spared")
+        return max(0, level - 1)
 
     def _pinned_head_worker(self):
         """Placement-pinned head scheduling. None: the head is unpinned
@@ -1278,6 +1390,11 @@ class SolveService:
             if blevel > level:
                 obs.inc("serve.degraded.backlog_driven")
                 level = blevel
+        if self._tenancy is not None:
+            # Recompute the degradation offender once per level read —
+            # _tenant_level then consults the cached verdict at every
+            # application site without rescanning the queue.
+            self._offender = self._tenant_offender()
         return level
 
     # -- continuous batching (lane table + refill state machine) -------
@@ -1309,6 +1426,11 @@ class SolveService:
         return dtype
 
     def _lane_cohort(self, entry: _Entry, level: int) -> str:
+        # Tenant-scoped rung first (no-op with tenancy off): a spared
+        # tenant's float64 must not downshift — and must not be spliced
+        # into a downshifted table — just because the offender's rung
+        # says 3.
+        level = self._tenant_level(entry, level)
         p = entry.request.problem
         base = f"{p.M}x{p.N}:{self._effective_dtype(entry, level)}:xla"
         if self._precond(entry.request) == "mg":
@@ -1412,7 +1534,8 @@ class SolveService:
             and self._lane_cohort(e, level) == head_cohort
             and e.request.problem == head.request.problem
         )
-        if level >= 1:
+        head_level = self._tenant_level(head, level)
+        if head_level >= 1:
             # Padding shrink: size the table to the work actually
             # waiting — no speculative lanes when every real member
             # counts.
@@ -1440,10 +1563,10 @@ class SolveService:
                 or table.verify_every != verify_every):
             table = worker.table = None
         if table is None:
-            if level >= 1:
+            if head_level >= 1:
                 obs.inc("serve.degraded.padding")
             self._count_defensive_verify(verify_every)
-            eff_dtype = self._effective_dtype(head, level)
+            eff_dtype = self._effective_dtype(head, head_level)
             table = worker.table = LaneTable(
                 head_cohort, head.request.problem,
                 None if eff_dtype == "auto" else eff_dtype,
@@ -1463,6 +1586,29 @@ class SolveService:
                       bucket=bucket, level=level, worker=worker.id)
         if not table.free_lane_count():
             return
+        lane_cap = None
+        if self._tenancy is not None:
+            # Per-bucket lane fair share: when more than one tenant has
+            # lane-eligible work for THIS table's cohort, each tenant's
+            # resident-lane count is capped at its share of the bucket
+            # (ceil, min 1) — one tenant cannot monopolize a bucket
+            # executable's lanes while a competitor waits. With a
+            # single tenant present the cap is void (work-conserving:
+            # fairness must never idle lanes nobody else wants).
+            present = {self._tenant(e.request) for e in self._queue
+                       if self._lane_eligible(e)
+                       and self._lane_cohort(e, level) == table.cohort
+                       and e.request.problem == table.problem}
+            present |= {self._tenant(e.request)
+                        for e in table.occupants()}
+            if len(present) > 1:
+                total_share = sum(self._tenancy.share_of(t)
+                                  for t in present)
+                lane_cap = {
+                    t: max(1, int(np.ceil(
+                        table.bucket * self._tenancy.share_of(t)
+                        / total_share)))
+                    for t in present}
         kept: deque = deque()
         while self._queue and table.free_lane_count():
             entry = self._queue.popleft()
@@ -1483,6 +1629,17 @@ class SolveService:
             if not table.taint_compatible(entry):
                 kept.append(entry)     # waits for its taint partner
                 continue
+            tenant = self._tenant(entry.request)
+            if lane_cap is not None:
+                held = sum(1 for o in table.occupants()
+                           if self._tenant(o.request) == tenant)
+                if held >= lane_cap.get(tenant, table.bucket):
+                    # Over fair share with a competitor waiting: defer
+                    # (kept, re-offered next refill), never shed — the
+                    # cap costs position, not the request.
+                    obs.inc("serve.tenant.lane_deferred")
+                    kept.append(entry)
+                    continue
             breaker = self._breaker(worker, table.cohort)
             if not breaker.allow():
                 obs.inc("serve.refill.refill_denied_by_breaker")
@@ -1490,7 +1647,8 @@ class SolveService:
                            f"circuit breaker open for cohort "
                            f"{table.cohort} at refill")
                 continue
-            if level >= 2:
+            eff_level = self._tenant_level(entry, level, count=True)
+            if eff_level >= 2:
                 entry.iter_cap = min(
                     entry.request.problem.iteration_cap,
                     self.policy.degradation.degraded_iteration_cap)
@@ -1500,9 +1658,11 @@ class SolveService:
                 # degraded must not stick to a retried entry splicing
                 # into a now-healthy service.
                 entry.iter_cap = None
-            if (level >= 3
+            if (eff_level >= 3
                     and (entry.request.dtype or "auto") == "float64"):
                 obs.inc("serve.degraded.precision")
+            if tenant is not None:
+                obs.inc(f"serve.tenant.dispatches.{tenant}")
             lane = table.splice(entry, entry.request.rhs_gate)
             rid = entry.request.request_id
             if self._journal is not None:
@@ -1515,6 +1675,8 @@ class SolveService:
             self._flight.end(rid, SPAN_QUEUE)
             attrs = dict(mode="lane", bucket=table.bucket, lane=lane,
                          level=level, worker=worker.id)
+            if tenant is not None:
+                attrs["tenant"] = tenant
             if entry.request.geometry is not None:
                 attrs["geometry"] = fingerprint_of(entry.request.geometry)
             self._flight.begin(rid, SPAN_RESIDENT, **attrs)
@@ -1708,6 +1870,11 @@ class SolveService:
         policy = self.policy
         obs.gauge("serve.load_level", level)
         head = batch[0]
+        # Tenant-scoped degradation: the batch is dispatched at the
+        # head's effective rung (batches are cohort-homogeneous; a
+        # spared tenant's head runs one rung gentler than the
+        # offender's — serve.tenant.degraded_{offender,spared}).
+        level = self._tenant_level(head, level, count=level > 0)
         problem = head.request.problem
         dtype = head.request.dtype
         exact_bucket = False
@@ -1775,6 +1942,10 @@ class SolveService:
             self._flight.end(rid, SPAN_QUEUE)
             attrs = dict(dispatch=did, mode=mode, batch=len(batch),
                          level=level, worker=worker.id)
+            tenant = self._tenant(entry.request)
+            if tenant is not None:
+                obs.inc(f"serve.tenant.dispatches.{tenant}")
+                attrs["tenant"] = tenant
             if entry.request.geometry is not None:
                 # Fingerprint attribution: a mixed-geometry dispatch's
                 # members are distinguishable in the causal trace.
@@ -2195,6 +2366,24 @@ class SolveService:
                         f"{message} (attempt {entry.attempts}/"
                         f"{max_attempts})")
             return
+        if self._tenancy is not None:
+            # Per-tenant retry budget (Dean & Barroso 2013): every
+            # requeue spends a token only successes refund. A poisoned
+            # tenant exhausts it after retry_budget requeues and each
+            # later retry converts into this typed error — its total
+            # dispatch count is bounded by admitted + retry_budget, so
+            # a retry storm cannot multiply load on a degraded fleet.
+            tenant = self._tenant(entry.request)
+            if not self._tenancy.spend_retry(tenant):
+                obs.inc("serve.tenant.retry_exhausted")
+                obs.event("serve.tenant.retry_exhausted",
+                          request_id=str(entry.request.request_id),
+                          tenant=tenant, error=error_type)
+                self._error(entry, error_type,
+                            f"{message} (tenant {tenant!r} retry budget "
+                            "exhausted)")
+                return
+            obs.inc(f"serve.tenant.retries.{tenant}")
         delay = self._backoff_delay(entry.attempts)
         if entry.deadline is not None:
             remaining = entry.deadline.remaining()
@@ -2324,6 +2513,11 @@ class SolveService:
                 <= self.policy.slo.latency_objective_seconds)
         fo = self._close_flight(entry, OUTCOME_RESULT, flag, latency,
                                 entry.attempts + 1, good)
+        tenant = self._tenant(entry.request)
+        if tenant is not None:
+            obs.inc(f"serve.tenant.completed.{tenant}")
+            self._tenancy.credit_success(tenant)
+            self._tenant_slo_tracker(tenant).record(latency, good)
         if self._forecast is not None and converged and not partial:
             # Only full converged solves calibrate the cohort model —
             # a deadline partial's iteration count measures the budget,
@@ -2352,6 +2546,10 @@ class SolveService:
         latency = self._latency(entry)
         fo = self._close_flight(entry, OUTCOME_ERROR, error_type,
                                 latency, max(1, entry.attempts), False)
+        tenant = self._tenant(entry.request)
+        if tenant is not None:
+            obs.inc(f"serve.tenant.errors.{tenant}")
+            self._tenant_slo_tracker(tenant).record(latency, False)
         return self._record(Outcome(
             request_id=entry.request.request_id, kind=OUTCOME_ERROR,
             error_type=error_type, message=message,
@@ -2369,6 +2567,10 @@ class SolveService:
         latency = self._latency(entry)
         fo = self._close_flight(entry, OUTCOME_SHED, reason, latency,
                                 entry.attempts, False)
+        tenant = self._tenant(entry.request)
+        if tenant is not None:
+            obs.inc(f"serve.tenant.shed.{tenant}")
+            self._tenant_slo_tracker(tenant).record(latency, False)
         return self._record(Outcome(
             request_id=entry.request.request_id, kind=OUTCOME_SHED,
             shed_reason=reason, message=message,
@@ -2437,6 +2639,16 @@ class SolveService:
             entry.attempts = pend.attempts
             entry.taint = set(pend.taint)
             entry.taint_fp = set(getattr(pend, "taint_fp", ()) or ())
+            if self._tenancy is not None:
+                # Rebuild the tenant ledger from the journal: register
+                # the tenant (share, fresh quota bucket) and re-charge
+                # its journaled dispatch attempts beyond the first
+                # against the retry budget — a poisoned tenant cannot
+                # reset its amplification cap by crashing the process
+                # mid-storm.
+                tenant = self._tenant(req)
+                self._tenancy.charge_attempts(tenant,
+                                              max(0, pend.attempts - 1))
             self._counts["recovered"] += 1
             obs.inc("serve.recovered")
             self._pending_ids.add(req.request_id)
@@ -2453,7 +2665,11 @@ class SolveService:
                                generation=pend.generation,
                                in_flight=pend.in_flight,
                                lost_hook=pend.lost_hook)
-            self._flight.begin(rid, SPAN_QUEUE, recovered=True)
+            if self._tenancy is not None:
+                self._flight.begin(rid, SPAN_QUEUE, recovered=True,
+                                   tenant=self._tenant(req))
+            else:
+                self._flight.begin(rid, SPAN_QUEUE, recovered=True)
             # Topology-aware recovery: work that was on a device this
             # topology no longer has is REMAPPED audibly — never
             # silently resumed onto a ghost device id. A hard pin that
@@ -2540,6 +2756,13 @@ class SolveService:
                     b.state
         router = (self._router.stats() if self._router is not None
                   else None)
+        tenants = None
+        if self._tenancy is not None:
+            tenants = self._tenancy.describe()
+            for name, tracker in self._tenant_slo.items():
+                row = tenants.setdefault(name, {})
+                row["slo_budget_remaining"] = round(
+                    tracker.budget_remaining(), 6)
         return {
             "admitted": c["admitted"],
             "completed": c["completed"],
@@ -2548,6 +2771,7 @@ class SolveService:
             "recovered": c["recovered"],
             "pending": pending,
             **({"router": router} if router is not None else {}),
+            **({"tenants": tenants} if tenants is not None else {}),
             "lost": (c["admitted"] + c["recovered"]
                      - (c["completed"] + c["errors"] + c["shed"])
                      - pending),
@@ -2576,5 +2800,23 @@ class SolveService:
         obs.gauge("serve.shed_rate", round(s["shed_rate"], 6))
         obs.gauge("serve.queue_depth", s["pending"])
         obs.gauge("serve.lost_requests", s["lost"])
+        if self._tenancy is not None:
+            # Per-tenant gauges for the scoreboard's tenants pane —
+            # flat scalar families (one suffix per tenant) so the
+            # prefix scan renders them identically from a live
+            # endpoint and a trace-dir snapshot.
+            for name, row in self._tenancy.describe().items():
+                obs.gauge(f"serve.tenant.share.{name}", row["share"])
+                obs.gauge(f"serve.tenant.quota_tokens.{name}",
+                          row["quota_tokens"])
+                obs.gauge(f"serve.tenant.retry_tokens.{name}",
+                          row["retry_tokens"])
+            shortest = (min(self.policy.slo.burn_windows)
+                        if self.policy.slo.burn_windows else None)
+            for name, tracker in self._tenant_slo.items():
+                tracker.publish()
+                if shortest is not None:
+                    obs.gauge(f"serve.tenant.slo_burn.{name}",
+                              round(tracker.burn_rate(shortest), 4))
         if self._forecast is not None:
             self._forecast_backlog()

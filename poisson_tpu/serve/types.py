@@ -27,7 +27,11 @@ Outcome kinds:
   unbounded memory growth), ``breaker_open`` (the request's cohort is
   circuit-broken), ``deadline_expired`` (the budget ran out while the
   request was still queued — dispatching it would burn capacity on an
-  answer nobody is waiting for).
+  answer nobody is waiting for), ``predicted_deadline`` (the forecast
+  guard priced the deadline hopeless before any compute), or
+  ``quota_exceeded`` (the tenant is over its admission quota —
+  ``ServicePolicy.tenancy``; one hot client's overload never becomes
+  everyone's).
 """
 
 from __future__ import annotations
@@ -38,6 +42,7 @@ from typing import Callable, Optional, Tuple, Union
 from poisson_tpu.config import Problem
 from poisson_tpu.integrity.probe import IntegrityPolicy
 from poisson_tpu.krylov import KrylovPolicy
+from poisson_tpu.serve.tenancy import TenancyPolicy
 
 OUTCOME_RESULT = "result"
 OUTCOME_ERROR = "error"
@@ -60,6 +65,10 @@ SHED_DEADLINE_EXPIRED = "deadline_expired"
 # pre-empted at a lane boundary) BEFORE burning the compute, which is
 # the whole point of forecasting.
 SHED_PREDICTED_DEADLINE = "predicted_deadline"
+# The tenant's token-bucket admission quota is empty
+# (ServicePolicy.tenancy): refused at admission, zero compute burned —
+# per-client overload is that client's problem, not the fleet's.
+SHED_QUOTA_EXCEEDED = "quota_exceeded"
 
 
 class TransientDispatchError(RuntimeError):
@@ -149,6 +158,14 @@ class SolveRequest:
     warm_start: Optional[object] = None
     warm_geometry: Optional[object] = None
     on_solution: Optional[Callable] = None
+    # The client identity behind the request (``rhs_gate`` is the
+    # multi-tenant *payload* knob; this is the multi-tenant *identity*
+    # knob). With ``ServicePolicy.tenancy`` set it selects the tenant's
+    # admission-quota bucket, fair-share weight, and retry budget
+    # (``serve.tenancy``); it rides the journal and the flight trace.
+    # None pools the request under the ``"default"`` pseudo-tenant —
+    # and with tenancy off (the default) it is inert metadata.
+    tenant: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -165,7 +182,9 @@ class Outcome:
     attempts: int = 1             # service-level dispatch attempts
     latency_seconds: float = 0.0  # admission → outcome, service clock
     error_type: str = ""          # divergence | transient | internal
-    shed_reason: str = ""         # queue_full | breaker_open | deadline_expired
+    shed_reason: str = ""         # queue_full | breaker_open |
+    #                               deadline_expired | predicted_deadline |
+    #                               quota_exceeded
     message: str = ""
     diff: Optional[float] = None  # final ‖Δw‖ (result outcomes)
     # Flight-recorder attribution (obs.flight): the request's causal
@@ -488,6 +507,16 @@ class ServicePolicy:
     routes nothing — every cohort string, program, and dispatch path
     stays byte-identical to every prior release (pinned by the
     ``serve.routed_default_f64`` contracts ledger entry).
+
+    ``tenancy`` arms tenant isolation & overload fairness
+    (:class:`~poisson_tpu.serve.tenancy.TenancyPolicy` —
+    ``poisson_tpu.serve.tenancy``): per-tenant token-bucket admission
+    quotas (typed ``quota_exceeded`` sheds), deficit-weighted
+    round-robin head selection in both engines, per-bucket lane-share
+    caps, retry budgets that convert a poisoned tenant's requeue storm
+    into typed errors, and tenant-scoped degradation/SLO accounting
+    (``serve.tenant.*``). None (the default) polices nothing — strict
+    FIFO service, byte- and behavior-identical to every prior release.
     """
 
     capacity: int = 64
@@ -507,3 +536,4 @@ class ServicePolicy:
     session: SessionPolicy = SessionPolicy()
     forecast: Optional[ForecastPolicy] = None
     router: Optional[RouterPolicy] = None
+    tenancy: Optional[TenancyPolicy] = None

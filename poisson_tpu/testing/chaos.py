@@ -111,6 +111,17 @@ router-mispredict-downshift a slow routed backend lands below its
                             misprediction, arm demotion, traffic
                             downshifts to the xla floor, and a
                             half-open re-probe recovers the arm
+tenant-noisy-neighbor       an aggressor flooding at 10× its quota
+                            share sheds typed ``quota_exceeded`` (zero
+                            compute) while the victim's completions and
+                            p99 match its solo baseline within 10%;
+                            the same schedule with tenancy OFF
+                            demonstrably starves the victim
+tenant-retry-storm          a poison-fault tenant exhausts its retry
+                            budget: dispatches bounded by admitted +
+                            budget, exhausted retries become typed
+                            errors, the steady tenant is untouched,
+                            co-batch taint holds across tenants
 ==========================  ============================================
 
 Every scenario resets the metrics registry, runs against a
@@ -2067,6 +2078,197 @@ def _router_mispredict_downshift(seed: int) -> dict:
     }, {"chosen": st["chosen"],
         "demoted_arms": st["demoted_arms"],
         "measured_fractions": st["measured_fractions"]})
+
+
+def _tenant_arm(seed, tenancy, victim_n, aggressor_n, deadline):
+    """One arm of the noisy-neighbor experiment: the victim submits
+    ``victim_n`` requests and the aggressor floods ``aggressor_n`` into
+    the same queue (same seed → same order), every dispatch burning a
+    fixed slice of virtual time. Returns (per-tenant outcome lists,
+    admission sheds by tenant, service)."""
+    from poisson_tpu.serve import (
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+    )
+
+    vc = VirtualClock()
+
+    def metered_dispatch(requests, attempts):
+        vc.advance(0.05)        # every dispatch costs one queue slice
+
+    svc = SolveService(
+        ServicePolicy(capacity=64, max_batch=1, tenancy=tenancy,
+                      degradation=_quiet_degradation()),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=metered_dispatch)
+    p = _problem()
+    rng = random.Random(seed)
+    shed_at_admission = {"victim": [], "aggressor": []}
+    # The flood lands first — FIFO puts every aggressor request ahead
+    # of the victim, which is exactly the starvation the fair queue
+    # must undo.
+    plan = ([("aggressor", k) for k in range(aggressor_n)]
+            + [("victim", k) for k in range(victim_n)])
+    for tenant, k in plan:
+        out = svc.submit(SolveRequest(
+            request_id=f"{tenant}-{k}", problem=p, tenant=tenant,
+            deadline_seconds=deadline, rhs_gate=1.0 + rng.random()))
+        if out is not None:
+            shed_at_admission[tenant].append(out)
+    outs = {"victim": [], "aggressor": []}
+    for o in svc.drain():
+        outs[str(o.request_id).split("-")[0]].append(o)
+    return outs, shed_at_admission, svc
+
+
+@scenario("tenant-noisy-neighbor", group="tenancy")
+def _tenant_noisy_neighbor(seed: int) -> dict:
+    """Weighted-fair admission end to end, both arms in one scenario.
+    With tenancy ON, an aggressor flooding at 10× its quota share is
+    refused at admission — typed ``quota_exceeded`` sheds that burn
+    zero compute — and the victim's completed count and p99 stay
+    within 10% of its solo baseline. With tenancy OFF, the *same*
+    seeded schedule demonstrably starves the victim: FIFO drains the
+    flood first and the victim's deadlines expire in queue. All three
+    arms share one metrics registry (every arm drains fully, so the
+    ledger invariant closes over their sum — and the campaign's
+    flight-recorder rail counts every arm's causal traces against it);
+    the ``serve.tenant.*`` counters still read as the tenancy-on
+    arm's alone, because the off arms have no ledger to tick them."""
+    from poisson_tpu.serve import (
+        OUTCOME_SHED,
+        SHED_QUOTA_EXCEEDED,
+        TenancyPolicy,
+    )
+
+    victim_n, deadline = 20, 1.2
+    # Aggressor bucket = quota_burst × share = 1 token: its fair
+    # admission is ONE request, and it floods ten.
+    tenancy = TenancyPolicy(shares=(("victim", 20.0), ("aggressor", 1.0)),
+                            quota_rate=1e-3, quota_burst=1.0)
+
+    # Arm 1 — solo baseline: the victim alone on the same schedule.
+    solo, _, _ = _tenant_arm(seed, None, victim_n, 0, deadline)
+    solo_done = [o for o in solo["victim"] if o.converged]
+    solo_p99 = float(np.percentile(
+        [o.latency_seconds for o in solo_done], 99))
+
+    # Arm 2 — tenancy OFF under the flood: FIFO starves the victim.
+    off, _, _ = _tenant_arm(seed, None, victim_n, 10, deadline)
+    off_done = [o for o in off["victim"] if o.converged]
+
+    # Arm 3 — tenancy ON, same seeded schedule.
+    on, shed, svc = _tenant_arm(seed, tenancy, victim_n, 10, deadline)
+    on_done = [o for o in on["victim"] if o.converged]
+    on_p99 = float(np.percentile(
+        [o.latency_seconds for o in on_done], 99)) if on_done else 1e9
+    quota_sheds = shed["aggressor"]
+    return _finish("tenant-noisy-neighbor", seed, {
+        "solo_baseline_all_served": len(solo_done) == victim_n,
+        "off_arm_starves_victim": len(off_done) < victim_n,
+        "on_arm_victim_all_served": len(on_done) == victim_n
+        and len(on_done) == len(solo_done),
+        "on_arm_victim_p99_within_10pct": on_p99 <= 1.10 * solo_p99,
+        "aggressor_shed_typed_quota": len(quota_sheds) >= 8
+        and all(o.kind == OUTCOME_SHED
+                and o.shed_reason == SHED_QUOTA_EXCEEDED
+                for o in quota_sheds),
+        "quota_sheds_burned_zero_compute": all(
+            (o.decomposition or {}).get("compute_s", 1) == 0
+            and (o.decomposition or {}).get("dispatches", 1) == 0
+            for o in quota_sheds),
+        "quota_sheds_counted":
+            _counter("serve.tenant.quota_sheds") == len(quota_sheds)
+            and _counter("serve.shed.quota_exceeded") == len(quota_sheds),
+        "aggressor_admitted_its_share":
+            _counter("serve.tenant.dispatches.aggressor") >= 1,
+    }, {"solo_p99": solo_p99, "on_p99": on_p99,
+        "off_victim_completed": len(off_done),
+        "on_victim_completed": len(on_done),
+        "aggressor_quota_sheds": len(quota_sheds)})
+
+
+@scenario("tenant-retry-storm", group="tenancy")
+def _tenant_retry_storm(seed: int) -> dict:
+    """Per-tenant retry budgets cap requeue amplification. A tenant
+    whose every request is poison (batch-killing) spends its retry
+    budget and then its retries convert into typed errors instead of
+    requeues: total dispatches for the poisoned tenant are bounded by
+    ``admitted + retry_budget``, asserted from the emitted metrics
+    snapshot. The steady tenant's outcomes are untouched, and co-batch
+    taint is still honored ACROSS tenants — a steady member killed as
+    the poison's batchmate is requeued isolated and converges. The
+    breaker is quieted (it would otherwise shed the poisoned cohort
+    before the budget engages — this scenario is about the budget)."""
+    from poisson_tpu.serve import (
+        BreakerPolicy,
+        OUTCOME_ERROR,
+        RetryPolicy,
+        ServicePolicy,
+        SolveRequest,
+        SolveService,
+        TenancyPolicy,
+    )
+    from poisson_tpu.testing.faults import poison_batch_fault
+
+    retry_budget = 3
+    vc = VirtualClock()
+    poison_ids = {f"poison-{k}" for k in range(2)}
+    svc = SolveService(
+        ServicePolicy(
+            capacity=32, max_batch=2,
+            retry=RetryPolicy(max_attempts=50, backoff_base=0.01,
+                              backoff_cap=0.05),
+            breaker=BreakerPolicy(failure_threshold=10**6),
+            degradation=_quiet_degradation(),
+            # Default retry_refund (1.0): the steady tenant's budget is
+            # replenished by its successes, so collateral kills from
+            # co-batched poison never exhaust it — while the poison
+            # tenant, which never completes anything, earns no refunds
+            # and hits the cap.
+            tenancy=TenancyPolicy(retry_budget=retry_budget)),
+        clock=vc, sleep=vc.sleep, seed=seed,
+        dispatch_fault=poison_batch_fault(poison_ids))
+    p = _problem()
+    rng = random.Random(seed)
+    # Interleave so the first batches co-mingle the tenants: the taint
+    # seam must isolate across the tenant boundary too. The steady
+    # tenant absorbs two collateral kills — within its own budget, and
+    # its completions refund the spend (retries paced by successes),
+    # so only the tenant that never succeeds runs dry.
+    plan = [("poison", 0), ("steady", 0), ("poison", 1),
+            ("steady", 1), ("steady", 2), ("steady", 3)]
+    for tenant, k in plan:
+        svc.submit(SolveRequest(request_id=f"{tenant}-{k}", problem=p,
+                                tenant=tenant,
+                                rhs_gate=1.0 + rng.random()))
+    outs = {o.request_id: o for o in svc.drain()}
+    poison_outs = [outs[f"poison-{k}"] for k in range(2)]
+    steady_outs = [outs[f"steady-{k}"] for k in range(4)]
+    dispatches = _counter("serve.tenant.dispatches.poison")
+    admitted = _counter("serve.tenant.admitted.poison")
+    return _finish("tenant-retry-storm", seed, {
+        "requeue_amplification_capped":
+            0 < dispatches <= admitted + retry_budget,
+        "budget_exhaustion_typed":
+            _counter("serve.tenant.retry_exhausted") >= 1
+            and all(o.kind == OUTCOME_ERROR and o.error_type == "transient"
+                    for o in poison_outs),
+        "exhaustion_audible_in_message": any(
+            "retry budget exhausted" in (o.message or "")
+            for o in poison_outs),
+        "steady_tenant_untouched":
+            all(o.converged for o in steady_outs)
+            and _counter("serve.tenant.completed.steady") == 4
+            and _counter("serve.tenant.errors.steady") == 0,
+        "cross_tenant_taint_honored":
+            _counter("serve.requeued.isolated") >= 1
+            and any(o.attempts > 1 for o in steady_outs),
+    }, {"poison_dispatches": dispatches,
+        "poison_admitted": admitted,
+        "retry_budget": retry_budget,
+        "steady_attempts": [o.attempts for o in steady_outs]})
 
 
 # -- campaign runner ----------------------------------------------------

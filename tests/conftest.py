@@ -167,6 +167,17 @@ def pytest_configure(config):
         "dual-source render; CPU-fast; runs in tier-1, selectable "
         "with -m router)",
     )
+    config.addinivalue_line(
+        "markers",
+        "tenancy: tenant-isolation & overload-fairness suite "
+        "(default-off byte-compat pin, token-bucket quota arithmetic + "
+        "zero-compute typed sheds, DWRR share convergence under both "
+        "engines, retry-budget exhaustion typed error, tenant identity "
+        "surviving journal replay/--recover with budgets "
+        "reconstructed, per-tenant SLO burn, tenant_mix regress cohort "
+        "pins, tenant-spec CLI validation; CPU-fast; runs in tier-1, "
+        "selectable with -m tenancy)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
